@@ -1,0 +1,27 @@
+"""lddl_tpu: a TPU-native distributed data preprocessing + loading framework
+for language-model pretraining.
+
+Re-designed from scratch for TPU hosts (JAX/XLA/pallas/pjit) with the same
+four-stage capability surface as the reference LDDL library
+(/root/reference/README.md:128-138):
+
+  1. downloaders  -> one-document-per-line text shards
+  2. preprocessors -> tokenized, paired, (optionally masked + binned)
+                      pretraining examples as Parquet shards
+  3. load balancer -> equal (+/-1) samples per shard
+  4. data loaders  -> deterministic, zero-communication binned iteration
+                      feeding sharded JAX arrays onto a device mesh
+
+Key architectural departures from the reference:
+  - The Dask-on-MPI substrate is replaced by ``lddl_tpu.pipeline`` — a
+    purpose-built partitioned map/shuffle engine over a process pool per
+    host plus a pluggable ``lddl_tpu.comm`` collective backend
+    (``jax.distributed`` on TPU pods).
+  - Hot loops (masking, binning, collation) are batched array programs
+    (numpy on host, JAX/pallas on device) instead of per-sample Python.
+  - The torch/torch_mp/paddle loader triplication collapses into one JAX
+    frontend covering the union of their capabilities (dp-group feeding,
+    micro-batching + loss_mask, samples_seen resume, binned iteration).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+from .types import File
+from .utils import (
+    attach_bool_arg,
+    deserialize_np_array,
+    expand_outdir_and_mkdir,
+    get_all_bin_ids,
+    get_all_parquets_under,
+    get_all_files_paths_under,
+    get_all_txt_files_under,
+    get_file_paths_for_bin_id,
+    get_num_samples_of_parquet,
+    parse_str_of_num_bytes,
+    serialize_np_array,
+)

@@ -1,0 +1,63 @@
+"""Resumable, explicitly-stated RNG primitives.
+
+This is the foundation of the framework's communication-free determinism:
+every rank evolves an identical "world" RNG state, so shard permutations,
+bin draws, and shuffle-buffer replacements agree across ranks and across
+resumes without any collective communication. Capability parity: reference
+``lddl/random.py:28-55``.
+
+We keep CPython's Mersenne-Twister state (rather than JAX threefry) for all
+*shard-level* decisions so the semantics survive process boundaries and are
+serializable with plain tuples; device-side randomness (dynamic masking)
+uses counter-based JAX keys in :mod:`lddl_tpu.ops`.
+"""
+
+import random as _py_random
+
+
+def _swap_rng_state(new_state):
+  # Fails loudly (TypeError) on None: callers must thread an explicit state;
+  # silently reusing the global state would destroy resumable determinism.
+  old_state = _py_random.getstate()
+  _py_random.setstate(new_state)
+  return old_state
+
+
+def get_state(seed):
+  """A fresh Mersenne state initialized from ``seed``."""
+  orig = _py_random.getstate()
+  _py_random.seed(seed)
+  state = _py_random.getstate()
+  _py_random.setstate(orig)
+  return state
+
+
+def randrange(stop, rng_state=None):
+  orig_rng_state = _swap_rng_state(rng_state)
+  n = _py_random.randrange(stop)
+  return n, _swap_rng_state(orig_rng_state)
+
+
+def random(rng_state=None):
+  orig_rng_state = _swap_rng_state(rng_state)
+  x = _py_random.random()
+  return x, _swap_rng_state(orig_rng_state)
+
+
+def shuffle(x, rng_state=None):
+  orig_rng_state = _swap_rng_state(rng_state)
+  _py_random.shuffle(x)
+  return _swap_rng_state(orig_rng_state)
+
+
+def sample(population, k, rng_state=None):
+  orig_rng_state = _swap_rng_state(rng_state)
+  s = _py_random.sample(population, k)
+  return s, _swap_rng_state(orig_rng_state)
+
+
+def choices(population, weights=None, cum_weights=None, k=1, rng_state=None):
+  orig_rng_state = _swap_rng_state(rng_state)
+  c = _py_random.choices(population, weights=weights, cum_weights=cum_weights,
+                         k=k)
+  return c, _swap_rng_state(orig_rng_state)

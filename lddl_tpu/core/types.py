@@ -1,0 +1,18 @@
+"""Value types shared across the framework.
+
+Capability parity: reference ``lddl/types.py:26-33`` (``File`` record passed
+between the balancer and the datasets).
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class File:
+  """A shard file on disk together with its sample count."""
+
+  path: str
+  num_samples: int
+
+  def __str__(self):
+    return f"File(path={self.path}, num_samples={self.num_samples})"

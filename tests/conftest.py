@@ -1,0 +1,43 @@
+"""Test configuration: force an 8-device virtual CPU platform so that
+multi-chip sharding (mesh/pjit) is exercised without TPU hardware.
+
+Must run before jax is first imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+  os.environ['XLA_FLAGS'] = (
+      _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_corpus(tmp_path):
+  """A tiny one-document-per-line corpus in the framework's source format:
+
+  first whitespace-separated token of each line is the document id.
+  """
+  src = tmp_path / 'source'
+  src.mkdir()
+  docs = []
+  rng_words = [
+      'alpha', 'bravo', 'charlie', 'delta', 'echo', 'foxtrot', 'golf',
+      'hotel', 'india', 'juliet', 'kilo', 'lima', 'mike', 'november',
+  ]
+  import random
+  r = random.Random(1234)
+  for d in range(24):
+    sents = []
+    for _ in range(r.randrange(3, 9)):
+      n = r.randrange(4, 12)
+      sents.append(' '.join(r.choice(rng_words) for _ in range(n)) + '.')
+    docs.append(f'doc-{d} ' + ' '.join(sents))
+  for shard in range(4):
+    with open(src / f'{shard}.txt', 'w') as f:
+      for line in docs[shard::4]:
+        f.write(line + '\n')
+  return str(src)

@@ -1,0 +1,132 @@
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from lddl_tpu.core import (
+    File,
+    deserialize_np_array,
+    get_all_bin_ids,
+    get_all_parquets_under,
+    get_file_paths_for_bin_id,
+    get_num_samples_of_parquet,
+    parse_str_of_num_bytes,
+    serialize_np_array,
+)
+from lddl_tpu.core import random as lrandom
+
+
+def test_np_array_roundtrip():
+  for dtype in (np.uint16, np.int64, np.float32):
+    a = np.arange(17, dtype=dtype)
+    b = deserialize_np_array(serialize_np_array(a))
+    assert b.dtype == a.dtype
+    np.testing.assert_array_equal(a, b)
+
+
+def test_parquet_discovery_and_bins(tmp_path):
+  t = pa.table({'x': [1, 2, 3]})
+  paths = []
+  for shard in range(2):
+    for b in range(3):
+      p = tmp_path / f'shard-{shard}.parquet_{b}'
+      pq.write_table(t, p)
+      paths.append(str(p))
+  (tmp_path / 'notes.txt').write_text('not a parquet')
+  found = get_all_parquets_under(str(tmp_path))
+  assert sorted(found) == sorted(paths)
+  assert get_all_bin_ids(found) == [0, 1, 2]
+  assert len(get_file_paths_for_bin_id(found, 1)) == 2
+  assert get_num_samples_of_parquet(paths[0]) == 3
+
+
+def test_bin_ids_must_be_contiguous(tmp_path):
+  t = pa.table({'x': [1]})
+  for b in (0, 2):
+    pq.write_table(t, tmp_path / f's.parquet_{b}')
+  with pytest.raises(ValueError):
+    get_all_bin_ids(get_all_parquets_under(str(tmp_path)))
+
+
+def test_unbinned_parquet_has_no_bin(tmp_path):
+  t = pa.table({'x': [1]})
+  pq.write_table(t, tmp_path / 'part.0.parquet')
+  found = get_all_parquets_under(str(tmp_path))
+  assert len(found) == 1
+  assert get_all_bin_ids(found) == []
+
+
+def test_parse_num_bytes():
+  assert parse_str_of_num_bytes('128') == 128
+  assert parse_str_of_num_bytes('4k') == 4096
+  assert parse_str_of_num_bytes('2M') == 2 * 1024**2
+  assert parse_str_of_num_bytes('1g') == 1024**3
+  with pytest.raises(ValueError):
+    parse_str_of_num_bytes('xyz')
+
+
+def test_file_type():
+  f = File('/a/b.parquet', 10)
+  assert f.num_samples == 10 and 'b.parquet' in str(f)
+
+
+class TestResumableRng:
+
+  def test_identical_state_identical_draws(self):
+    s = lrandom.get_state(42)
+    n1, s1 = lrandom.randrange(1000, rng_state=s)
+    n2, s2 = lrandom.randrange(1000, rng_state=s)
+    assert n1 == n2 and s1 == s2
+
+  def test_state_evolves(self):
+    s = lrandom.get_state(42)
+    n1, s = lrandom.randrange(1000, rng_state=s)
+    n2, s = lrandom.randrange(1000, rng_state=s)
+    draws = {n1, n2}
+    for _ in range(8):
+      n, s = lrandom.randrange(1000, rng_state=s)
+      draws.add(n)
+    assert len(draws) > 2
+
+  def test_does_not_disturb_global_random(self):
+    import random as py_random
+    py_random.seed(7)
+    expected = [py_random.random() for _ in range(3)]
+    py_random.seed(7)
+    got = [py_random.random()]
+    s = lrandom.get_state(999)
+    _, s = lrandom.randrange(10, rng_state=s)
+    got.append(py_random.random())
+    lrandom.shuffle(list(range(10)), rng_state=s)
+    got.append(py_random.random())
+    assert got == expected
+
+  def test_shuffle_sample_choices(self):
+    s = lrandom.get_state(0)
+    x1 = list(range(20))
+    x2 = list(range(20))
+    s1 = lrandom.shuffle(x1, rng_state=s)
+    s2 = lrandom.shuffle(x2, rng_state=s)
+    assert x1 == x2 and s1 == s2 and x1 != list(range(20))
+    samp, _ = lrandom.sample(list(range(100)), 5, rng_state=s1)
+    assert len(samp) == 5
+    ch, _ = lrandom.choices([0, 1, 2], weights=[1, 1, 1], k=4, rng_state=s1)
+    assert len(ch) == 4
+
+
+def test_logger_scopes(tmp_path):
+  from lddl_tpu.core.log import DatasetLogger, DummyLogger
+  lg = DatasetLogger(log_dir=str(tmp_path), rank=1, local_rank=1, node_rank=0)
+  assert isinstance(lg.to('node'), DummyLogger)
+  lg.set_worker(0)
+  real = lg.to('rank')
+  assert not isinstance(real, DummyLogger)
+  real.info('hello from rank scope')
+  lg.set_worker(1)
+  assert isinstance(lg.to('rank'), DummyLogger)
+  assert not isinstance(lg.to('worker'), DummyLogger)
+  with pytest.raises(ValueError):
+    lg.to('galaxy')
+  assert os.path.exists(tmp_path / 'node-0_rank-1.log')
