@@ -1,0 +1,7 @@
+from .backend import (
+    CommBackend,
+    FileBackend,
+    JaxProcessBackend,
+    NullBackend,
+    get_backend,
+)

@@ -1,0 +1,4 @@
+from .partition import TextSlice, estimate_block_size, plan_text_partitions, read_lines
+from .executor import Executor
+from .shuffle import shuffle_lines
+from .parquet_io import write_samples_partition, read_samples

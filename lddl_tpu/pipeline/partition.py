@@ -1,0 +1,71 @@
+"""Byte-range partitioning of one-document-per-line text shards.
+
+Replaces ``dask.bag.read_text(blocksize=...)`` (reference
+``lddl/dask/readers.py:48-70``) with an explicit plan: each partition is a
+list of byte slices; slice boundaries are arbitrary, and the reader applies
+the standard convention that a line straddling a slice's *start* belongs to
+the previous slice, so no newline scanning is needed at planning time.
+"""
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class TextSlice:
+  path: str
+  start: int
+  end: int  # exclusive
+
+
+def estimate_block_size(paths, num_blocks):
+  """Total corpus bytes / desired block count (reference readers.py:48-57)."""
+  total = sum(os.path.getsize(p) for p in paths)
+  if num_blocks <= 0:
+    raise ValueError('num_blocks must be positive')
+  return max(1, -(-total // num_blocks))  # ceil div
+
+
+def plan_text_partitions(paths, block_size):
+  """One partition per ~block_size byte slice, in sorted path order."""
+  partitions = []
+  for path in sorted(paths):
+    size = os.path.getsize(path)
+    if size == 0:
+      continue
+    start = 0
+    while start < size:
+      end = min(start + block_size, size)
+      partitions.append(TextSlice(path, start, end))
+      start = end
+  return partitions
+
+
+def read_lines(text_slice, encoding='utf-8'):
+  """Yield the complete '\\n'-separated lines owned by a slice.
+
+  Ownership rule: a line belongs to the slice in which it *starts*. A slice
+  whose start is mid-line skips to the next newline; a slice whose last line
+  straddles its end reads past the end to finish that line. (Documents using
+  other delimiters, e.g. the CRLF-delimited bimodal code corpus, have their
+  own reader in :mod:`lddl_tpu.preprocess.readers`.)
+  """
+  with open(text_slice.path, 'rb') as f:
+    pos = text_slice.start
+    if pos > 0:
+      f.seek(pos - 1)
+      prev = f.read(1)
+      if prev != b'\n':
+        # We started mid-line: the line belongs to the previous slice.
+        chunk = f.readline()
+        pos += len(chunk)
+    else:
+      f.seek(0)
+    while pos < text_slice.end:
+      line = f.readline()
+      if not line:
+        break
+      pos += len(line)
+      text = line.decode(encoding).rstrip('\r\n')
+      if text.strip():
+        yield text

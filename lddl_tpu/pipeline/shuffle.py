@@ -1,0 +1,95 @@
+"""Deterministic two-phase global shuffle of text lines on disk.
+
+Replaces the reference's Dask dataframe shuffle trick
+(``_shuffle_bag_texts``: bag -> dataframe with a random column -> shuffle ->
+sample(1.0), reference ``lddl/dask/bert/pretrain.py:100-111``) with an
+explicit scatter/gather through spill files:
+
+  phase A (scatter): each input partition assigns every line a target
+    output partition with a seeded RNG and appends it to
+    ``<spill>/tgt<j>/src<i>.txt`` — one file per (source, target) pair, so
+    there are no concurrent writers per file;
+  phase B (gather): output partition j concatenates its spill files in
+    sorted source order and shuffles locally with a seeded RNG.
+
+Both phases are pure functions of (seed, partition index), so any rank or
+worker can recompute any partition — the shuffle is deterministic and
+restartable.
+"""
+
+import functools
+import os
+
+from ..core import random as lrandom
+from .partition import read_lines
+
+
+def _scatter_state(seed, src_index):
+  return lrandom.get_state(f'{seed}:scatter:{src_index}')
+
+
+def _gather_state(seed, tgt_index):
+  return lrandom.get_state(f'{seed}:gather:{tgt_index}')
+
+
+def scatter_partition(lines, src_index, num_targets, spill_dir, seed):
+  """Phase A for one input partition. Returns per-target line counts."""
+  state = _scatter_state(seed, src_index)
+  buckets = [[] for _ in range(num_targets)]
+  for line in lines:
+    j, state = lrandom.randrange(num_targets, rng_state=state)
+    buckets[j].append(line)
+  counts = []
+  for j, bucket in enumerate(buckets):
+    counts.append(len(bucket))
+    if not bucket:
+      continue
+    tgt_dir = os.path.join(spill_dir, f'tgt{j}')
+    os.makedirs(tgt_dir, exist_ok=True)
+    tmp = os.path.join(tgt_dir, f'.src{src_index}.tmp')
+    with open(tmp, 'w', encoding='utf-8') as f:
+      for line in bucket:
+        f.write(line + '\n')
+    os.rename(tmp, os.path.join(tgt_dir, f'src{src_index}.txt'))
+  return counts
+
+
+def gather_partition(tgt_index, spill_dir, seed):
+  """Phase B for one output partition: concat spills + local shuffle."""
+  tgt_dir = os.path.join(spill_dir, f'tgt{tgt_index}')
+  lines = []
+  if os.path.isdir(tgt_dir):
+    names = sorted(
+        (f for f in os.listdir(tgt_dir) if f.endswith('.txt')),
+        key=lambda n: int(n[len('src'):-len('.txt')]))
+    for name in names:
+      with open(os.path.join(tgt_dir, name), encoding='utf-8') as f:
+        lines.extend(l.rstrip('\n') for l in f)
+  lrandom.shuffle(lines, rng_state=_gather_state(seed, tgt_index))
+  return lines
+
+
+def _scatter_slices_task(part_slices, idx, num_targets, spill_dir, seed):
+  lines = (line for s in part_slices for line in read_lines(s))
+  return scatter_partition(lines, idx, num_targets, spill_dir, seed)
+
+
+def shuffle_lines(executor, partitions, spill_dir, seed, num_targets=None):
+  """Shuffle all lines of ``partitions`` into ``num_targets`` shuffled
+  output partitions on disk. Returns the number of output partitions.
+
+  ``partitions`` is a list of :class:`TextSlice` lists/iterables as produced
+  by :func:`plan_text_partitions` (each element = one partition's slices).
+  """
+  partitions = list(partitions)
+  if num_targets is None:
+    num_targets = len(partitions)
+  task = functools.partial(
+      _scatter_slices_task,
+      num_targets=num_targets,
+      spill_dir=spill_dir,
+      seed=seed)
+  # map(gather=False) ends with a barrier, so all spills are visible to all
+  # ranks when this returns.
+  executor.map(task, partitions, gather=False)
+  return num_targets

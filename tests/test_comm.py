@@ -1,0 +1,62 @@
+import multiprocessing as mp
+import os
+
+import numpy as np
+
+from lddl_tpu.comm import FileBackend, NullBackend, get_backend
+
+
+def test_null_backend():
+  b = NullBackend()
+  assert b.rank == 0 and b.world_size == 1
+  assert b.allgather_object('x') == ['x']
+  np.testing.assert_array_equal(
+      b.allreduce_sum(np.array([1, 2])), np.array([1, 2]))
+  b.barrier()
+  assert b.broadcast_object(7) == 7
+
+
+def _file_backend_worker(rank, world, d, q):
+  b = FileBackend(d, rank, world, timeout=30.0)
+  got = b.allgather_object({'rank': rank, 'sq': rank * rank})
+  total = b.allreduce_sum(np.full((3,), rank, dtype=np.uint64))
+  b.barrier()
+  root_val = b.broadcast_object(f'from-{rank}', root=1)
+  q.put((rank, got, total.tolist(), root_val))
+
+
+def test_file_backend_three_ranks(tmp_path):
+  world = 3
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [
+      ctx.Process(target=_file_backend_worker, args=(r, world, str(tmp_path), q))
+      for r in range(world)
+  ]
+  for p in procs:
+    p.start()
+  results = {}
+  for _ in range(world):
+    rank, got, total, root_val = q.get(timeout=60)
+    results[rank] = (got, total, root_val)
+  for p in procs:
+    p.join(timeout=30)
+    assert p.exitcode == 0
+  for rank in range(world):
+    got, total, root_val = results[rank]
+    assert [g['rank'] for g in got] == [0, 1, 2]
+    assert got[2]['sq'] == 4
+    assert total == [3, 3, 3]  # 0+1+2
+    assert root_val == 'from-1'
+
+
+def test_get_backend_env(tmp_path, monkeypatch):
+  monkeypatch.setenv('LDDL_COMM', 'file')
+  monkeypatch.setenv('LDDL_COMM_DIR', str(tmp_path))
+  monkeypatch.setenv('LDDL_RANK', '0')
+  monkeypatch.setenv('LDDL_WORLD_SIZE', '1')
+  b = get_backend()
+  assert isinstance(b, FileBackend)
+  assert b.allgather_object(1) == [1]
+  monkeypatch.setenv('LDDL_COMM', 'null')
+  assert isinstance(get_backend(), NullBackend)

@@ -1,0 +1,188 @@
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pyarrow as pa
+
+from lddl_tpu.comm import FileBackend
+from lddl_tpu.core import get_all_bin_ids, get_all_parquets_under
+from lddl_tpu.pipeline import (
+    Executor,
+    TextSlice,
+    estimate_block_size,
+    plan_text_partitions,
+    read_lines,
+    shuffle_lines,
+    write_samples_partition,
+    read_samples,
+)
+from lddl_tpu.pipeline.shuffle import gather_partition
+
+
+def _write(tmp_path, name, lines):
+  p = tmp_path / name
+  p.write_text('\n'.join(lines) + '\n')
+  return str(p)
+
+
+class TestPartitioning:
+
+  def test_slices_cover_all_lines_exactly_once(self, tmp_path):
+    lines = [f'doc-{i} word ' * (i % 5 + 1) for i in range(200)]
+    p = _write(tmp_path, 'a.txt', lines)
+    for block in (7, 64, 1000, 10**6):
+      parts = plan_text_partitions([p], block)
+      got = [l for s in parts for l in read_lines(s)]
+      assert got == lines, f'block={block}'
+
+  def test_multiple_files_sorted(self, tmp_path):
+    pb = _write(tmp_path, 'b.txt', ['b1', 'b2'])
+    pa_ = _write(tmp_path, 'a.txt', ['a1'])
+    parts = plan_text_partitions([pb, pa_], 10**6)
+    got = [l for s in parts for l in read_lines(s)]
+    assert got == ['a1', 'b1', 'b2']
+
+  def test_estimate_block_size(self, tmp_path):
+    p = _write(tmp_path, 'a.txt', ['x' * 99])
+    assert estimate_block_size([p], 4) == 25
+
+  def test_blank_lines_skipped(self, tmp_path):
+    p = _write(tmp_path, 'a.txt', ['one', '', '  ', 'two'])
+    parts = plan_text_partitions([p], 10**6)
+    assert [l for s in parts for l in read_lines(s)] == ['one', 'two']
+
+
+def _double(task, idx):
+  return task * 2
+
+
+class TestExecutor:
+
+  def test_serial_map(self):
+    ex = Executor(num_local_workers=1)
+    assert ex.map(_double, [1, 2, 3]) == [2, 4, 6]
+
+  def test_process_pool_map(self):
+    ex = Executor(num_local_workers=2)
+    assert ex.map(_double, list(range(10))) == [2 * i for i in range(10)]
+
+  def test_gather_false_returns_local_only(self):
+    ex = Executor(num_local_workers=1)
+    local = ex.map(_double, [5, 6], gather=False)
+    assert sorted(local) == [(0, 10), (1, 12)]
+
+
+def _dist_executor_worker(rank, world, d, src_dir, q):
+  comm = FileBackend(d, rank, world, timeout=60.0)
+  ex = Executor(comm=comm, num_local_workers=1)
+  results = ex.map(_double, [10, 20, 30, 40, 50])
+  q.put((rank, results))
+
+
+def test_executor_across_ranks(tmp_path):
+  world = 2
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [
+      ctx.Process(
+          target=_dist_executor_worker,
+          args=(r, world, str(tmp_path / 'rdzv'), str(tmp_path), q))
+      for r in range(world)
+  ]
+  for p in procs:
+    p.start()
+  outs = {}
+  for _ in range(world):
+    rank, res = q.get(timeout=60)
+    outs[rank] = res
+  for p in procs:
+    p.join(timeout=30)
+    assert p.exitcode == 0
+  assert outs[0] == outs[1] == [20, 40, 60, 80, 100]
+
+
+class TestShuffle:
+
+  def test_shuffle_preserves_multiset_and_is_deterministic(self, tmp_path):
+    lines = [f'doc-{i} payload-{i}' for i in range(300)]
+    src = _write(tmp_path, 'src.txt', lines)
+    parts = plan_text_partitions([src], 512)
+    groups = [[s] for s in parts]
+    ex = Executor(num_local_workers=1)
+
+    spill1 = str(tmp_path / 'spill1')
+    n = shuffle_lines(ex, groups, spill1, seed=77, num_targets=5)
+    out1 = [gather_partition(j, spill1, seed=77) for j in range(n)]
+    flat1 = [l for part in out1 for l in part]
+    assert sorted(flat1) == sorted(lines)
+    assert flat1 != lines  # actually shuffled
+
+    spill2 = str(tmp_path / 'spill2')
+    shuffle_lines(ex, groups, spill2, seed=77, num_targets=5)
+    out2 = [gather_partition(j, spill2, seed=77) for j in range(n)]
+    assert out1 == out2  # deterministic
+
+    spill3 = str(tmp_path / 'spill3')
+    shuffle_lines(ex, groups, spill3, seed=78, num_targets=5)
+    out3 = [gather_partition(j, spill3, seed=78) for j in range(n)]
+    assert [l for p in out3 for l in p] != flat1  # seed changes placement
+
+
+class TestParquetWriter:
+
+  def _samples(self, lengths):
+    return [{
+        'A': f'tok{i}',
+        'num_tokens': int(n),
+    } for i, n in enumerate(lengths)]
+
+  def test_unbinned(self, tmp_path):
+    schema = pa.schema([('A', pa.string()), ('num_tokens', pa.uint16())])
+    out = write_samples_partition(
+        self._samples([5, 100]), schema, str(tmp_path), 3)
+    (path, n), = out.values()
+    assert path.endswith('part.3.parquet') and n == 2
+    rows = read_samples(path)
+    assert rows[0]['A'] == 'tok0' and rows[1]['num_tokens'] == 100
+
+  def test_binned_contract(self, tmp_path):
+    schema = pa.schema([('A', pa.string()), ('num_tokens', pa.uint16())])
+    # target_seq_length=128, bin_size=32 -> nbins=4
+    lengths = [1, 32, 33, 64, 65, 96, 97, 128, 500]
+    out = write_samples_partition(
+        self._samples(lengths), schema, str(tmp_path), 0, bin_size=32,
+        nbins=4)
+    assert set(out) == {0, 1, 2, 3}
+    counts = {b: n for b, (_, n) in out.items()}
+    # (n-1)//32 clamped: 1,32->0; 33,64->1; 65,96->2; 97,128,500->3
+    assert counts == {0: 2, 1: 2, 2: 2, 3: 3}
+    paths = get_all_parquets_under(str(tmp_path))
+    assert get_all_bin_ids(paths) == [0, 1, 2, 3]
+    for b, (path, n) in out.items():
+      rows = read_samples(path)
+      assert all(r['bin_id'] == b for r in rows)
+
+  def test_zero_token_samples_clamp_to_bin_zero(self, tmp_path):
+    schema = pa.schema([('A', pa.string()), ('num_tokens', pa.uint16())])
+    out = write_samples_partition(
+        self._samples([0, 1, 40]), schema, str(tmp_path), 0, bin_size=32,
+        nbins=2)
+    assert out[0][1] == 2 and out[1][1] == 1  # nothing silently dropped
+
+  def test_empty_bins_still_written(self, tmp_path):
+    schema = pa.schema([('A', pa.string()), ('num_tokens', pa.uint16())])
+    out = write_samples_partition(
+        self._samples([1, 2]), schema, str(tmp_path), 0, bin_size=32,
+        nbins=4)
+    assert out[3][1] == 0
+    assert get_all_bin_ids(get_all_parquets_under(str(tmp_path))) == [
+        0, 1, 2, 3
+    ]
+
+  def test_txt_debug_format(self, tmp_path):
+    schema = pa.schema([('A', pa.string()), ('num_tokens', pa.uint16())])
+    out = write_samples_partition(
+        self._samples([4]), schema, str(tmp_path), 1, output_format='txt')
+    (path, n), = out.values()
+    assert path.endswith('part.1.txt') and n == 1
+    assert 'tok0' in open(path).read()
