@@ -15,6 +15,18 @@ uses counter-based JAX keys in :mod:`lddl_tpu.ops`.
 import random as _py_random
 
 
+def rng_from_key(*parts):
+  """An independent ``random.Random`` deterministically seeded from a
+  structured key, e.g. ``rng_from_key(seed, 'pairs', partition_idx)``.
+
+  String seeding is stable across processes and Python versions (it hashes
+  the string with sha512 internally, not ``hash()``), so any worker can
+  reconstruct any partition's RNG — the property the whole preprocessing
+  pipeline's restartability rests on.
+  """
+  return _py_random.Random(':'.join(str(p) for p in parts))
+
+
 def _swap_rng_state(new_state):
   # Fails loudly (TypeError) on None: callers must thread an explicit state;
   # silently reusing the global state would destroy resumable determinism.

@@ -69,6 +69,27 @@ def gather_partition(tgt_index, spill_dir, seed):
   return lines
 
 
+def _scatter_corpus_task(src_idx, idx, corpus, num_targets, spill_dir, seed):
+  del idx
+  return scatter_partition(
+      corpus.read_partition(src_idx), src_idx, num_targets, spill_dir, seed)
+
+
+def shuffle_corpus(executor, corpus, spill_dir, seed, num_targets=None):
+  """Shuffle a :class:`~lddl_tpu.preprocess.readers.Corpus` (honoring its
+  per-partition subsampling) into ``num_targets`` on-disk partitions."""
+  if num_targets is None:
+    num_targets = corpus.num_partitions
+  task = functools.partial(
+      _scatter_corpus_task,
+      corpus=corpus,
+      num_targets=num_targets,
+      spill_dir=spill_dir,
+      seed=seed)
+  executor.map(task, list(range(corpus.num_partitions)), gather=False)
+  return num_targets
+
+
 def _scatter_slices_task(part_slices, idx, num_targets, spill_dir, seed):
   lines = (line for s in part_slices for line in read_lines(s))
   return scatter_partition(lines, idx, num_targets, spill_dir, seed)

@@ -1,0 +1,1 @@
+from .readers import Corpus, split_id_text

@@ -1,0 +1,416 @@
+"""BERT pretraining preprocessor.
+
+Turns one-document-per-line corpora into next-sentence-prediction pairs with
+optional static MLM masking and sequence-length binning, written as Parquet
+shards. Output schema and on-disk naming are interoperable with the
+reference (``lddl/dask/bert/pretrain.py:444-498``):
+
+  A: str                     space-joined WordPiece tokens of segment A
+  B: str                     space-joined WordPiece tokens of segment B
+  is_random_next: bool       NSP label
+  num_tokens: uint16         len(A) + len(B) + 3 ([CLS] + 2x[SEP])
+  [masked_lm_positions: binary   serialized uint16 positions into the
+                                 assembled [CLS] A [SEP] B [SEP] sequence]
+  [masked_lm_labels: str         space-joined original tokens]
+  [bin_id: int64             when binned]
+
+Pairing follows the standard BERT recipe (segment chunks to a target
+length, 50% random-next B, random front/back truncation; reference
+``pretrain.py:241-365``) — but every random draw here threads an explicit
+per-partition RNG, so unlike the reference (which uses the unseeded global
+``random`` inside Dask workers) the whole pipeline is deterministic given
+(seed, corpus): identical reruns produce identical shards.
+"""
+
+import argparse
+import dataclasses
+import functools
+import os
+import shutil
+import time
+
+import numpy as np
+import pyarrow as pa
+
+from ..core import attach_bool_arg, serialize_np_array
+from ..core.random import rng_from_key
+from ..pipeline.executor import Executor
+from ..pipeline.parquet_io import write_samples_partition
+from ..pipeline.shuffle import gather_partition, shuffle_corpus
+from ..tokenization import split_sentences
+from ..tokenization.wordpiece import load_bert_tokenizer
+from .readers import read_corpus, split_id_text
+
+
+@dataclasses.dataclass(frozen=True)
+class Document:
+  doc_id: str
+  sentences: tuple  # tuple of tuples of tokens
+
+  def __len__(self):
+    return len(self.sentences)
+
+  def __getitem__(self, i):
+    return self.sentences[i]
+
+
+def documents_from_lines(lines, tokenizer, max_length=512,
+                         sentence_backend='auto'):
+  """Parse raw document lines into tokenized Documents.
+
+  All sentences of all documents are tokenized in a single batched backend
+  call, then redistributed — the partition-level equivalent of the
+  reference's per-sentence ``tokenizer.tokenize`` loop
+  (``lddl/dask/bert/pretrain.py:77-97``).
+  """
+  doc_ids, doc_sentence_strs = [], []
+  for line in lines:
+    doc_id, text = split_id_text(line)
+    if not text:
+      continue
+    sents = [s.strip() for s in split_sentences(text, backend=sentence_backend)]
+    sents = [s for s in sents if s]
+    if sents:
+      doc_ids.append(doc_id)
+      doc_sentence_strs.append(sents)
+  flat = [s for sents in doc_sentence_strs for s in sents]
+  flat_tokens = tokenizer.batch_tokenize(flat, max_length=max_length)
+  documents = []
+  pos = 0
+  for doc_id, sents in zip(doc_ids, doc_sentence_strs):
+    toks = [tuple(t) for t in flat_tokens[pos:pos + len(sents)]]
+    pos += len(sents)
+    toks = [t for t in toks if t]
+    if toks:
+      documents.append(Document(doc_id, tuple(toks)))
+  return documents
+
+
+def truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, rng):
+  """Randomly trim the longer segment from the front or back until the pair
+  fits (reference ``pretrain.py:161-176``)."""
+  while len(tokens_a) + len(tokens_b) > max_num_tokens:
+    trunc = tokens_a if len(tokens_a) > len(tokens_b) else tokens_b
+    if rng.random() < 0.5:
+      del trunc[0]
+    else:
+      trunc.pop()
+
+
+def create_masked_lm_predictions(tokens_a, tokens_b, masked_lm_ratio,
+                                 vocab_words, rng, max_predictions=None):
+  """Static MLM masking over the assembled [CLS] A [SEP] B [SEP] sequence.
+
+  Standard 80/10/10 recipe (reference ``pretrain.py:182-238``). Positions
+  index the assembled sequence. Returns the masked A/B token lists plus
+  sorted (positions, labels).
+  """
+  n_a, n_b = len(tokens_a), len(tokens_b)
+  tokens = ['[CLS]'] + list(tokens_a) + ['[SEP]'] + list(tokens_b) + ['[SEP]']
+  cand = [i for i, t in enumerate(tokens) if t not in ('[CLS]', '[SEP]')]
+  rng.shuffle(cand)
+  num_to_predict = max(1, int(round(len(tokens) * masked_lm_ratio)))
+  if max_predictions is not None:
+    num_to_predict = min(num_to_predict, max_predictions)
+  picked = sorted(cand[:num_to_predict])
+  labels = [tokens[i] for i in picked]
+  for i in picked:
+    r = rng.random()
+    if r < 0.8:
+      tokens[i] = '[MASK]'
+    elif r < 0.9:
+      pass  # keep original
+    else:
+      tokens[i] = vocab_words[rng.randrange(len(vocab_words))]
+  return (
+      tokens[1:1 + n_a],
+      tokens[2 + n_a:2 + n_a + n_b],
+      picked,
+      labels,
+  )
+
+
+def create_pairs_from_document(
+    all_documents,
+    document_index,
+    rng,
+    max_seq_length=128,
+    short_seq_prob=0.1,
+    masking=False,
+    masked_lm_ratio=0.15,
+    vocab_words=None,
+):
+  """NSP pair construction for one document (reference
+  ``pretrain.py:241-365``): accumulate sentence chunks up to a target
+  length, split at a random point into A, and with probability 0.5 replace
+  the continuation by sentences from a random other document in the
+  partition."""
+  document = all_documents[document_index]
+  max_num_tokens = max_seq_length - 3
+  target_seq_length = max_num_tokens
+  if rng.random() < short_seq_prob:
+    target_seq_length = rng.randint(2, max_num_tokens)
+
+  instances = []
+  chunk = []
+  chunk_len = 0
+  i = 0
+  while i < len(document):
+    chunk.append(document[i])
+    chunk_len += len(document[i])
+    if i == len(document) - 1 or chunk_len >= target_seq_length:
+      if chunk:
+        a_end = 1 if len(chunk) < 2 else rng.randint(1, len(chunk) - 1)
+        tokens_a = [t for seg in chunk[:a_end] for t in seg]
+        tokens_b = []
+        if len(chunk) == 1 or rng.random() < 0.5:
+          # Random next: fill B from a random other document.
+          is_random_next = True
+          target_b_length = target_seq_length - len(tokens_a)
+          random_document_index = document_index
+          for _ in range(10):
+            candidate = rng.randint(0, len(all_documents) - 1)
+            if candidate != document_index:
+              random_document_index = candidate
+              break
+          if random_document_index == document_index:
+            is_random_next = False
+          random_document = all_documents[random_document_index]
+          start = rng.randint(0, len(random_document) - 1)
+          for j in range(start, len(random_document)):
+            tokens_b.extend(random_document[j])
+            if len(tokens_b) >= target_b_length:
+              break
+          # Unused trailing segments of the chunk are replayed.
+          i -= len(chunk) - a_end
+        else:
+          is_random_next = False
+          tokens_b = [t for seg in chunk[a_end:] for t in seg]
+        truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, rng)
+        if tokens_a and tokens_b:
+          if masking:
+            tokens_a, tokens_b, positions, labels = (
+                create_masked_lm_predictions(tokens_a, tokens_b,
+                                             masked_lm_ratio, vocab_words,
+                                             rng))
+          instance = {
+              'A': ' '.join(tokens_a),
+              'B': ' '.join(tokens_b),
+              'is_random_next': is_random_next,
+              'num_tokens': len(tokens_a) + len(tokens_b) + 3,
+          }
+          if masking:
+            instance['masked_lm_positions'] = serialize_np_array(
+                np.asarray(positions, dtype=np.uint16))
+            instance['masked_lm_labels'] = ' '.join(labels)
+          instances.append(instance)
+      chunk = []
+      chunk_len = 0
+    i += 1
+  return instances
+
+
+def bert_schema(masking):
+  fields = [
+      ('A', pa.string()),
+      ('B', pa.string()),
+      ('is_random_next', pa.bool_()),
+      ('num_tokens', pa.uint16()),
+  ]
+  if masking:
+    fields += [
+        ('masked_lm_positions', pa.binary()),
+        ('masked_lm_labels', pa.string()),
+    ]
+  return pa.schema(fields)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertPretrainConfig:
+  vocab_file: str = None
+  tokenizer_name: str = None
+  lowercase: bool = True
+  tokenizer_backend: str = 'hf'
+  sentence_backend: str = 'auto'
+  target_seq_length: int = 128
+  short_seq_prob: float = 0.1
+  duplicate_factor: int = 5
+  masking: bool = False
+  masked_lm_ratio: float = 0.15
+  bin_size: int = None
+  seed: int = 12345
+  output_format: str = 'parquet'
+
+  @property
+  def nbins(self):
+    if self.bin_size is None:
+      return None
+    if self.target_seq_length % self.bin_size != 0:
+      raise ValueError('bin_size must divide target_seq_length')
+    return self.target_seq_length // self.bin_size
+
+
+_TOKENIZER_CACHE = {}
+
+
+def _get_tokenizer(cfg):
+  key = (cfg.vocab_file, cfg.tokenizer_name, cfg.lowercase,
+         cfg.tokenizer_backend)
+  if key not in _TOKENIZER_CACHE:
+    _TOKENIZER_CACHE[key] = load_bert_tokenizer(
+        vocab_file=cfg.vocab_file,
+        hub_name=cfg.tokenizer_name,
+        lowercase=cfg.lowercase,
+        backend=cfg.tokenizer_backend)
+  return _TOKENIZER_CACHE[key]
+
+
+def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
+  """Worker task: shuffled lines of one partition -> pair instances ->
+  (binned) Parquet. Returns {bin_id_or_None: num_samples}."""
+  del global_idx
+  tokenizer = _get_tokenizer(cfg)
+  lines = gather_partition(tgt_idx, spill_dir, cfg.seed)
+  documents = documents_from_lines(
+      lines, tokenizer, sentence_backend=cfg.sentence_backend)
+  rng = rng_from_key(cfg.seed, 'pairs', tgt_idx)
+  instances = []
+  for _ in range(cfg.duplicate_factor):
+    for di in range(len(documents)):
+      instances.extend(
+          create_pairs_from_document(
+              documents,
+              di,
+              rng,
+              max_seq_length=cfg.target_seq_length,
+              short_seq_prob=cfg.short_seq_prob,
+              masking=cfg.masking,
+              masked_lm_ratio=cfg.masked_lm_ratio,
+              vocab_words=tokenizer.vocab_words,
+          ))
+  out = write_samples_partition(
+      instances,
+      bert_schema(cfg.masking),
+      out_dir,
+      tgt_idx,
+      bin_size=cfg.bin_size,
+      nbins=cfg.nbins,
+      output_format=cfg.output_format,
+  )
+  return {b: n for b, (_, n) in out.items()}
+
+
+def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
+  """Execute the full preprocess: global doc shuffle -> pair/mask/bin ->
+  Parquet shards under ``sink_dir``. Returns per-partition sample counts."""
+  executor = executor or Executor()
+  os.makedirs(sink_dir, exist_ok=True)
+  spill_dir = os.path.join(sink_dir, '_shuffle_spill')
+  # Pre-clean stale spills (a rerun with fewer partitions or a crashed
+  # scatter would otherwise merge leftovers into the output), and remove
+  # the plaintext spill copy once the run has succeeded.
+  if executor.comm.rank == 0 and os.path.isdir(spill_dir):
+    shutil.rmtree(spill_dir)
+  executor.comm.barrier()
+  n = shuffle_corpus(
+      executor, corpus, spill_dir, cfg.seed,
+      num_targets=num_shuffle_partitions)
+  task = functools.partial(
+      _process_partition, spill_dir=spill_dir, out_dir=sink_dir, cfg=cfg)
+  counts = executor.map(task, list(range(n)))
+  if executor.comm.rank == 0:
+    shutil.rmtree(spill_dir, ignore_errors=True)
+  return counts
+
+
+def attach_args(parser):
+  parser.add_argument('--wikipedia', type=str, default=None)
+  parser.add_argument('--books', type=str, default=None)
+  parser.add_argument('--common-crawl', type=str, default=None)
+  parser.add_argument('--open-webtext', type=str, default=None)
+  parser.add_argument('--source', type=str, default=None,
+                      help='generic one-doc-per-line source dir')
+  parser.add_argument('--sink', type=str, required=True)
+  parser.add_argument('--num-blocks', type=int, default=None)
+  parser.add_argument('--block-size', type=str, default=None,
+                      help='bytes per partition, accepts n[KMG]')
+  parser.add_argument('--sample-ratio', type=float, default=0.9)
+  parser.add_argument('--seed', type=int, default=12345)
+  parser.add_argument('--vocab-file', type=str, default=None)
+  parser.add_argument('--tokenizer', type=str, default=None,
+                      help='HF hub tokenizer name (needs egress)')
+  parser.add_argument('--tokenizer-backend', type=str, default='hf',
+                      choices=['hf', 'native'])
+  parser.add_argument('--sentence-backend', type=str, default='auto',
+                      choices=['auto', 'punkt', 'rules'])
+  parser.add_argument('--target-seq-length', type=int, default=128)
+  parser.add_argument('--short-seq-prob', type=float, default=0.1)
+  parser.add_argument('--duplicate-factor', type=int, default=5)
+  parser.add_argument('--bin-size', type=int, default=None)
+  parser.add_argument('--masked-lm-ratio', type=float, default=0.15)
+  attach_bool_arg(parser, 'masking', default=False,
+                  help_str='store static MLM masks')
+  attach_bool_arg(parser, 'lowercase', default=True)
+  parser.add_argument('--output-format', type=str, default='parquet',
+                      choices=['parquet', 'txt'])
+  parser.add_argument('--num-workers', type=int, default=None,
+                      help='local worker processes (default: all cores)')
+  parser.add_argument('--comm', type=str, default='null',
+                      choices=['null', 'file', 'jax'])
+  return parser
+
+
+def main(args=None):
+  parser = attach_args(
+      argparse.ArgumentParser(
+          description=__doc__,
+          formatter_class=argparse.ArgumentDefaultsHelpFormatter))
+  args = parser.parse_args(args)
+  from ..core.utils import parse_str_of_num_bytes
+  from ..comm import get_backend
+
+  dirs = [
+      d for d in (args.wikipedia, args.books, args.common_crawl,
+                  args.open_webtext, args.source) if d is not None
+  ]
+  if not dirs:
+    parser.error('need at least one source dir')
+  if not args.vocab_file and not args.tokenizer:
+    parser.error('need --vocab-file or --tokenizer')
+  comm = get_backend(args.comm)
+  executor = Executor(comm=comm, num_local_workers=args.num_workers)
+  block_size = (parse_str_of_num_bytes(args.block_size)
+                if args.block_size else None)
+  corpus = read_corpus(
+      dirs,
+      num_blocks=args.num_blocks or 4 * executor.num_local_workers *
+      comm.world_size,
+      block_size=block_size,
+      sample_ratio=args.sample_ratio,
+      sample_seed=args.seed,
+  )
+  cfg = BertPretrainConfig(
+      vocab_file=args.vocab_file,
+      tokenizer_name=args.tokenizer,
+      lowercase=args.lowercase,
+      tokenizer_backend=args.tokenizer_backend,
+      sentence_backend=args.sentence_backend,
+      target_seq_length=args.target_seq_length,
+      short_seq_prob=args.short_seq_prob,
+      duplicate_factor=args.duplicate_factor,
+      masking=args.masking,
+      masked_lm_ratio=args.masked_lm_ratio,
+      bin_size=args.bin_size,
+      seed=args.seed,
+      output_format=args.output_format,
+  )
+  t0 = time.perf_counter()
+  counts = run(corpus, args.sink, cfg, executor=executor)
+  if comm.rank == 0:
+    total = sum(n for c in counts for n in c.values())
+    print(f'preprocessed {total} samples into {len(counts)} partitions '
+          f'in {time.perf_counter() - t0:.1f}s')
+
+
+if __name__ == '__main__':
+  main()

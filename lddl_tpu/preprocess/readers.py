@@ -1,0 +1,94 @@
+"""Corpus readers: one-document-per-line text shards -> planned partitions.
+
+Input contract (shared with the downloaders; reference
+``lddl/dask/readers.py:60-147``): each ``.txt`` shard under a source
+directory holds one document per line, and the first whitespace-separated
+token of the line is the document id.
+
+The reference builds dask bags; here a :class:`Corpus` is a *plan* — a list
+of byte-slice partitions plus deterministic per-partition sampling — that
+the executor materializes anywhere.
+"""
+
+import dataclasses
+import os
+
+from ..core import get_all_txt_files_under
+from ..core.random import rng_from_key
+from ..pipeline.partition import (
+    estimate_block_size,
+    plan_text_partitions,
+    read_lines,
+)
+
+
+def split_id_text(raw_text):
+  """Split a document line into (doc_id, text)."""
+  parts = raw_text.split(None, 1)
+  if len(parts) < 2:
+    return parts[0] if parts else '', ''
+  return parts[0], parts[1]
+
+
+@dataclasses.dataclass(frozen=True)
+class Corpus:
+  """A partitioned view of one or more source directories."""
+
+  partitions: tuple  # tuple of tuples of TextSlice
+  sample_ratio: float = 1.0
+  sample_seed: int = 12345
+
+  @property
+  def num_partitions(self):
+    return len(self.partitions)
+
+  def read_partition(self, idx):
+    """Yield the (possibly subsampled) raw document lines of partition idx."""
+    rng = rng_from_key(self.sample_seed, 'corpus-sample', idx)
+    for s in self.partitions[idx]:
+      for line in read_lines(s):
+        if self.sample_ratio >= 1.0 or rng.random() < self.sample_ratio:
+          yield line
+
+
+def read_corpus(dirs, num_blocks=None, block_size=None, sample_ratio=1.0,
+                sample_seed=12345):
+  """Plan a corpus from source directories of one-doc-per-line txt shards.
+
+  Exactly one of num_blocks/block_size controls partition granularity
+  (reference ``lddl/dask/readers.py:48-70``).
+  """
+  paths = []
+  for d in ([dirs] if isinstance(dirs, str) else dirs):
+    if d is None:
+      continue
+    found = get_all_txt_files_under(d)
+    if not found:
+      raise ValueError(f'no .txt shards found under {d!r}')
+    paths.extend(found)
+  if block_size is None:
+    if num_blocks is None:
+      raise ValueError('need num_blocks or block_size')
+    block_size = estimate_block_size(paths, num_blocks)
+  slices = plan_text_partitions(paths, block_size)
+  return Corpus(
+      partitions=tuple((s,) for s in slices),
+      sample_ratio=sample_ratio,
+      sample_seed=sample_seed,
+  )
+
+
+def read_wikipedia(path, lang='en', **kwargs):
+  return read_corpus(os.path.join(path, lang), **kwargs)
+
+
+def read_books(path, **kwargs):
+  return read_corpus(os.path.join(path, 'source'), **kwargs)
+
+
+def read_common_crawl(path, **kwargs):
+  return read_corpus(path, **kwargs)
+
+
+def read_open_webtext(path, **kwargs):
+  return read_corpus(path, **kwargs)

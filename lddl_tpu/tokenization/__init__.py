@@ -1,0 +1,2 @@
+from .sentences import split_sentences
+from .wordpiece import BertWordPiece, load_bert_tokenizer

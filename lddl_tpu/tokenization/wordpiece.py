@@ -1,0 +1,106 @@
+"""WordPiece tokenization facade.
+
+One tokenizer object flows through preprocessing and loading. Backends:
+  - 'hf': HuggingFace ``BertTokenizerFast`` (Rust) constructed from a local
+    vocab file or hub name (reference ``lddl/dask/bert/pretrain.py:584-587``).
+  - 'native': this repo's C++ trie encoder (``lddl_tpu/native``), used for
+    the hot preprocessing loop when built.
+
+The facade exposes exactly what the framework needs: ``tokenize``,
+``convert_tokens_to_ids``, id-ordered ``vocab_words`` (for deterministic
+random-word masking draws), and the special tokens.
+"""
+
+import os
+
+
+class BertWordPiece:
+
+  def __init__(self, hf_tokenizer, native_encoder=None):
+    self._hf = hf_tokenizer
+    self._native = native_encoder
+    vocab = hf_tokenizer.get_vocab()
+    self._vocab_words = [t for t, _ in sorted(vocab.items(), key=lambda kv: kv[1])]
+
+  @property
+  def hf(self):
+    return self._hf
+
+  @property
+  def vocab_words(self):
+    """Vocabulary tokens ordered by token id."""
+    return self._vocab_words
+
+  @property
+  def vocab_size(self):
+    return len(self._vocab_words)
+
+  @property
+  def cls_token(self):
+    return self._hf.cls_token
+
+  @property
+  def sep_token(self):
+    return self._hf.sep_token
+
+  @property
+  def mask_token(self):
+    return self._hf.mask_token
+
+  @property
+  def mask_token_id(self):
+    return self._hf.mask_token_id
+
+  @property
+  def pad_token_id(self):
+    return self._hf.pad_token_id
+
+  def tokenize(self, text, max_length=None):
+    if self._native is not None:
+      tokens = self._native.tokenize(text)
+      return tokens[:max_length] if max_length else tokens
+    if max_length is not None:
+      return self._hf.tokenize(text, max_length=max_length, truncation=True)
+    return self._hf.tokenize(text)
+
+  def batch_tokenize(self, texts, max_length=None):
+    """Tokenize many texts in one backend call (the per-call Python overhead
+    of ``tokenize`` dominates at corpus scale; reference tokenizes one
+    sentence at a time, ``lddl/dask/bert/pretrain.py:79-80``)."""
+    if not texts:
+      return []
+    if self._native is not None:
+      out = self._native.batch_tokenize(texts)
+      return [t[:max_length] if max_length else t for t in out]
+    enc = self._hf(
+        list(texts),
+        add_special_tokens=False,
+        truncation=max_length is not None,
+        max_length=max_length)
+    return [self._hf.convert_ids_to_tokens(ids) for ids in enc['input_ids']]
+
+  def convert_tokens_to_ids(self, tokens):
+    return self._hf.convert_tokens_to_ids(list(tokens))
+
+  def get_special_tokens_mask(self, ids):
+    return self._hf.get_special_tokens_mask(ids, already_has_special_tokens=True)
+
+
+def load_bert_tokenizer(vocab_file=None, hub_name=None, lowercase=True,
+                        backend='hf'):
+  """Build a :class:`BertWordPiece` from a local vocab file (preferred on
+  egress-restricted TPU fleets) or a hub model name."""
+  from transformers import BertTokenizerFast
+  if vocab_file is not None:
+    hf = BertTokenizerFast(
+        vocab_file=os.path.abspath(os.path.expanduser(vocab_file)),
+        do_lower_case=lowercase)
+  elif hub_name is not None:
+    hf = BertTokenizerFast.from_pretrained(hub_name, do_lower_case=lowercase)
+  else:
+    raise ValueError('need vocab_file or hub_name')
+  native = None
+  if backend == 'native':
+    from ..native import wordpiece as native_wp
+    native = native_wp.NativeWordPiece.from_hf(hf)
+  return BertWordPiece(hf, native_encoder=native)

@@ -14,6 +14,22 @@ if '--xla_force_host_platform_device_count' not in _flags:
 
 import pytest  # noqa: E402
 
+WORDS = [
+    'alpha', 'bravo', 'charlie', 'delta', 'echo', 'foxtrot', 'golf',
+    'hotel', 'india', 'juliet', 'kilo', 'lima', 'mike', 'november',
+]
+
+
+@pytest.fixture(scope='session')
+def tiny_vocab(tmp_path_factory):
+  """A minimal WordPiece vocab covering the tmp_corpus words."""
+  path = tmp_path_factory.mktemp('vocab') / 'vocab.txt'
+  tokens = ['[PAD]', '[UNK]', '[CLS]', '[SEP]', '[MASK]', '.', ',']
+  tokens += WORDS
+  tokens += ['##' + w[1:] for w in WORDS]
+  path.write_text('\n'.join(tokens) + '\n')
+  return str(path)
+
 
 @pytest.fixture()
 def tmp_corpus(tmp_path):
@@ -24,17 +40,15 @@ def tmp_corpus(tmp_path):
   src = tmp_path / 'source'
   src.mkdir()
   docs = []
-  rng_words = [
-      'alpha', 'bravo', 'charlie', 'delta', 'echo', 'foxtrot', 'golf',
-      'hotel', 'india', 'juliet', 'kilo', 'lima', 'mike', 'november',
-  ]
+  rng_words = WORDS
   import random
   r = random.Random(1234)
   for d in range(24):
     sents = []
     for _ in range(r.randrange(3, 9)):
       n = r.randrange(4, 12)
-      sents.append(' '.join(r.choice(rng_words) for _ in range(n)) + '.')
+      sents.append(
+          (' '.join(r.choice(rng_words) for _ in range(n)) + '.').capitalize())
     docs.append(f'doc-{d} ' + ' '.join(sents))
   for shard in range(4):
     with open(src / f'{shard}.txt', 'w') as f:

@@ -1,0 +1,174 @@
+import os
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from lddl_tpu.core import (
+    deserialize_np_array,
+    get_all_bin_ids,
+    get_all_parquets_under,
+    get_num_samples_of_parquet,
+)
+from lddl_tpu.core.random import rng_from_key
+from lddl_tpu.pipeline.executor import Executor
+from lddl_tpu.preprocess import bert
+from lddl_tpu.preprocess.readers import read_corpus
+from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+
+
+@pytest.fixture()
+def tokenizer(tiny_vocab):
+  return load_bert_tokenizer(vocab_file=tiny_vocab)
+
+
+def _docs(tokenizer, n=6, sentences=5, words=8):
+  lines = []
+  from tests.conftest import WORDS
+  import random
+  r = random.Random(9)
+  for d in range(n):
+    sents = [
+        (' '.join(r.choice(WORDS) for _ in range(words)) + '.').capitalize()
+        for _ in range(sentences)
+    ]
+    lines.append(f'doc-{d} ' + ' '.join(sents))
+  return bert.documents_from_lines(lines, tokenizer)
+
+
+class TestDocuments:
+
+  def test_documents_structure(self, tokenizer):
+    docs = _docs(tokenizer)
+    assert len(docs) == 6
+    assert all(len(d) == 5 for d in docs)
+    assert all(t in tokenizer.vocab_words for d in docs for s in d.sentences
+               for t in s)
+
+  def test_empty_and_idless_lines_dropped(self, tokenizer):
+    docs = bert.documents_from_lines(['doc-0', 'doc-1 alpha bravo.'],
+                                     tokenizer)
+    assert len(docs) == 1 and docs[0].doc_id == 'doc-1'
+
+
+class TestPairs:
+
+  def test_pair_invariants(self, tokenizer):
+    docs = _docs(tokenizer)
+    rng = rng_from_key(1, 'test')
+    for di in range(len(docs)):
+      for inst in bert.create_pairs_from_document(
+          docs, di, rng, max_seq_length=32):
+        a, b = inst['A'].split(), inst['B'].split()
+        assert len(a) >= 1 and len(b) >= 1
+        assert inst['num_tokens'] == len(a) + len(b) + 3
+        assert inst['num_tokens'] <= 32
+
+  def test_deterministic_given_rng(self, tokenizer):
+    docs = _docs(tokenizer)
+    out1 = bert.create_pairs_from_document(docs, 0, rng_from_key(7, 'x'),
+                                           max_seq_length=32)
+    out2 = bert.create_pairs_from_document(docs, 0, rng_from_key(7, 'x'),
+                                           max_seq_length=32)
+    assert out1 == out2
+
+  def test_masking_fields(self, tokenizer):
+    docs = _docs(tokenizer)
+    rng = rng_from_key(3, 'mask')
+    instances = []
+    for di in range(len(docs)):
+      instances += bert.create_pairs_from_document(
+          docs, di, rng, max_seq_length=32, masking=True,
+          vocab_words=tokenizer.vocab_words)
+    assert instances
+    for inst in instances:
+      positions = deserialize_np_array(inst['masked_lm_positions'])
+      labels = inst['masked_lm_labels'].split()
+      assert positions.dtype == np.uint16
+      assert len(positions) == len(labels) >= 1
+      assert list(positions) == sorted(positions)
+      # positions index the assembled [CLS] A [SEP] B [SEP] sequence and
+      # never point at special tokens
+      a, b = inst['A'].split(), inst['B'].split()
+      n = len(a) + len(b) + 3
+      assembled = ['[CLS]'] + a + ['[SEP]'] + b + ['[SEP]']
+      for p, lab in zip(positions, labels):
+        assert 0 < p < n - 1
+        assert assembled[p] != '[CLS]' and assembled[p] != '[SEP]'
+        # at a masked position the current token is [MASK], the original
+        # label, or a random vocab word
+        assert lab in tokenizer.vocab_words
+
+  def test_masking_ratio_roughly_respected(self, tokenizer):
+    docs = _docs(tokenizer, n=10, sentences=8, words=10)
+    rng = rng_from_key(11, 'ratio')
+    tot_pos, tot_tok = 0, 0
+    for di in range(len(docs)):
+      for inst in bert.create_pairs_from_document(
+          docs, di, rng, max_seq_length=64, masking=True,
+          masked_lm_ratio=0.15, vocab_words=tokenizer.vocab_words):
+        tot_pos += len(deserialize_np_array(inst['masked_lm_positions']))
+        tot_tok += inst['num_tokens']
+    assert 0.10 < tot_pos / tot_tok < 0.20
+
+
+class TestEndToEnd:
+
+  def _run(self, tmp_corpus, tiny_vocab, sink, bin_size=None, masking=False,
+           seed=42):
+    cfg = bert.BertPretrainConfig(
+        vocab_file=tiny_vocab,
+        target_seq_length=32,
+        duplicate_factor=2,
+        masking=masking,
+        bin_size=bin_size,
+        seed=seed,
+        sentence_backend='rules',
+    )
+    corpus = read_corpus(tmp_corpus, num_blocks=4, sample_ratio=1.0)
+    ex = Executor(num_local_workers=1)
+    return bert.run(corpus, sink, cfg, executor=ex)
+
+  def test_unbinned_end_to_end(self, tmp_corpus, tiny_vocab, tmp_path):
+    sink = str(tmp_path / 'sink')
+    counts = self._run(tmp_corpus, tiny_vocab, sink)
+    parquets = get_all_parquets_under(sink)
+    assert parquets and get_all_bin_ids(parquets) == []
+    total = sum(get_num_samples_of_parquet(p) for p in parquets)
+    assert total == sum(n for c in counts for n in c.values()) > 0
+    rows = pq.read_table(parquets[0]).to_pylist()
+    assert set(rows[0]) == {'A', 'B', 'is_random_next', 'num_tokens'}
+
+  def test_binned_end_to_end(self, tmp_corpus, tiny_vocab, tmp_path):
+    sink = str(tmp_path / 'sink')
+    self._run(tmp_corpus, tiny_vocab, sink, bin_size=8, masking=True)
+    parquets = get_all_parquets_under(sink)
+    assert get_all_bin_ids(parquets) == [0, 1, 2, 3]
+    for p in parquets:
+      for row in pq.read_table(p).to_pylist():
+        b = row['bin_id']
+        assert b * 8 < row['num_tokens'] <= (b + 1) * 8 or (
+            b == 0 and row['num_tokens'] <= 8)
+        assert 'masked_lm_positions' in row
+
+  def test_bit_identical_reruns(self, tmp_corpus, tiny_vocab, tmp_path):
+    s1, s2, s3 = (str(tmp_path / n) for n in ('a', 'b', 'c'))
+    self._run(tmp_corpus, tiny_vocab, s1, bin_size=8, seed=42)
+    self._run(tmp_corpus, tiny_vocab, s2, bin_size=8, seed=42)
+    self._run(tmp_corpus, tiny_vocab, s3, bin_size=8, seed=43)
+    t1 = [pq.read_table(p) for p in get_all_parquets_under(s1)]
+    t2 = [pq.read_table(p) for p in get_all_parquets_under(s2)]
+    assert all(a.equals(b) for a, b in zip(t1, t2))
+    t3 = [pq.read_table(p) for p in get_all_parquets_under(s3)]
+    assert not all(a.equals(b) for a, b in zip(t1, t3))
+
+  def test_cli_main(self, tmp_corpus, tiny_vocab, tmp_path, capsys):
+    sink = str(tmp_path / 'sink')
+    bert.main([
+        '--source', tmp_corpus, '--sink', sink, '--vocab-file', tiny_vocab,
+        '--num-blocks', '4', '--sample-ratio', '1.0', '--bin-size', '8',
+        '--target-seq-length', '32', '--duplicate-factor', '1',
+        '--num-workers', '1', '--masking', '--sentence-backend', 'rules',
+    ])
+    assert 'preprocessed' in capsys.readouterr().out
+    assert get_all_bin_ids(get_all_parquets_under(sink)) == [0, 1, 2, 3]

@@ -1,0 +1,62 @@
+from lddl_tpu.tokenization import split_sentences
+from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+
+
+class TestSentences:
+
+  def test_basic_split(self):
+    out = split_sentences(
+        'The cat sat. The dog ran! Did it rain? Yes.', backend='rules')
+    assert out == ['The cat sat.', 'The dog ran!', 'Did it rain?', 'Yes.']
+
+  def test_abbreviations_not_split(self):
+    out = split_sentences('Dr. Smith went home. Mrs. Jones stayed.',
+                          backend='rules')
+    assert out == ['Dr. Smith went home.', 'Mrs. Jones stayed.']
+
+  def test_initialisms(self):
+    out = split_sentences('Born in the U.S. He moved abroad later on.',
+                          backend='rules')
+    assert len(out) <= 2  # 'U.S.' must not explode into fragments
+
+  def test_no_terminal_punct(self):
+    assert split_sentences('no punctuation here', backend='rules') == [
+        'no punctuation here'
+    ]
+
+  def test_empty(self):
+    assert split_sentences('', backend='rules') == []
+
+  def test_decimal_numbers_kept(self):
+    out = split_sentences('It cost 3.50 dollars. Cheap.', backend='rules')
+    assert out == ['It cost 3.50 dollars.', 'Cheap.']
+
+
+class TestWordPiece:
+
+  def test_tokenize_and_ids(self, tiny_vocab):
+    t = load_bert_tokenizer(vocab_file=tiny_vocab)
+    toks = t.tokenize('Alpha bravo.')
+    assert toks == ['alpha', 'bravo', '.']
+    ids = t.convert_tokens_to_ids(toks)
+    assert all(isinstance(i, int) and i >= 0 for i in ids)
+
+  def test_batch_matches_single(self, tiny_vocab):
+    t = load_bert_tokenizer(vocab_file=tiny_vocab)
+    texts = ['alpha bravo charlie.', 'delta echo', 'kilo lima mike november.']
+    batch = t.batch_tokenize(texts)
+    assert batch == [t.tokenize(x) for x in texts]
+
+  def test_batch_truncation(self, tiny_vocab):
+    t = load_bert_tokenizer(vocab_file=tiny_vocab)
+    out = t.batch_tokenize(['alpha bravo charlie delta echo'], max_length=3)
+    assert out == [['alpha', 'bravo', 'charlie']]
+
+  def test_vocab_words_id_ordered(self, tiny_vocab):
+    t = load_bert_tokenizer(vocab_file=tiny_vocab)
+    assert t.vocab_words[0] == '[PAD]'
+    assert t.convert_tokens_to_ids([t.vocab_words[7]]) == [7]
+
+  def test_unknown_token(self, tiny_vocab):
+    t = load_bert_tokenizer(vocab_file=tiny_vocab)
+    assert t.tokenize('zzzzz') == ['[UNK]']
