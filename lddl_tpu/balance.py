@@ -1,0 +1,221 @@
+"""Load balancer: equalize per-shard sample counts.
+
+Capability parity: reference ``lddl/dask/load_balance.py`` (console scripts
+``balance_dask_output`` + ``generate_num_samples_cache``). Input: a directory
+of (possibly binned) Parquet shards with unequal sample counts; output:
+``shard-<idx>.parquet[_<bin_id>]`` files where every shard of a bin holds
+``n`` or ``n+1`` samples, plus a ``.num_samples.json`` metadata cache
+(reference ``load_balance.py:372-378``).
+
+Architectural departure: the reference balances by *iterative pairwise
+transfer* — each round pairs the largest shard with the smallest and rewrites
+both whole Parquet files until converged (``load_balance.py:321-369``), an
+O(rounds × bytes) IO-amplified loop. Here balancing is *planned first*:
+
+  1. every rank counts its strided slice of input files from Parquet footer
+     metadata only and the counts are allreduce-summed (same collective
+     shape as reference ``load_balance.py:210-242``);
+  2. the deterministically-ordered input files are treated as one logical
+     concatenated stream of samples, and output shard ``i`` is assigned the
+     contiguous slice ``[i*n + min(i, r), ...)`` where ``n = total // S``
+     and ``r = total % S`` — by construction every shard gets ``n`` or
+     ``n+1`` samples, no iteration needed;
+  3. rank ``i % world`` materializes shard ``i`` by reading exactly the
+     overlapping input row ranges and writing the output file **once**.
+
+Every input byte is read once and every output byte written once, while the
+on-disk contract (naming, ±1 balance, metadata cache) is preserved. All
+ranks compute the identical plan from the identical allreduced counts, so —
+like the reference — no bulk data ever moves between ranks, only through
+the shared filesystem.
+"""
+
+import argparse
+import json
+import os
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from .comm import get_backend
+from .core import (
+    File,
+    get_all_bin_ids,
+    get_all_parquets_under,
+    get_file_paths_for_bin_id,
+    get_num_samples_of_parquet,
+)
+
+NUM_SAMPLES_CACHE = '.num_samples.json'
+
+
+def count_samples(paths, comm):
+  """Per-file sample counts with strided ownership + allreduce.
+
+  Rank ``r`` reads footers of ``paths[r::world]``; the uint64 count vector
+  is summed across ranks (reference ``load_balance.py:226-242``).
+  """
+  counts = np.zeros((len(paths),), dtype=np.uint64)
+  for i in range(comm.rank, len(paths), comm.world_size):
+    counts[i] = get_num_samples_of_parquet(paths[i])
+  if comm.world_size > 1:
+    counts = comm.allreduce_sum(counts)
+  return [File(p, int(c)) for p, c in zip(paths, counts)]
+
+
+def plan_shards(files, num_shards):
+  """Assign contiguous sample slices of the concatenated input stream to
+  output shards.
+
+  Returns a list (one entry per output shard) of lists of
+  ``(file_index, row_start, row_stop)`` read ranges. Shard sizes are
+  ``n+1`` for the first ``total % num_shards`` shards and ``n`` after —
+  the balanced ±1 contract (reference ``load_balance.py:159-168``).
+  """
+  total = sum(f.num_samples for f in files)
+  n, r = divmod(total, num_shards)
+  starts = [i * n + min(i, r) for i in range(num_shards + 1)]
+  file_offsets = np.cumsum([0] + [f.num_samples for f in files])
+  plans = []
+  fi = 0
+  for s in range(num_shards):
+    lo, hi = starts[s], starts[s + 1]
+    ranges = []
+    while fi < len(files) and file_offsets[fi + 1] <= lo:
+      fi += 1
+    j = fi
+    while j < len(files) and file_offsets[j] < hi:
+      a = max(lo, int(file_offsets[j])) - int(file_offsets[j])
+      b = min(hi, int(file_offsets[j + 1])) - int(file_offsets[j])
+      if b > a:
+        ranges.append((j, a, b))
+      j += 1
+    plans.append(ranges)
+  return plans
+
+
+def _materialize_shard(files, ranges, out_path, compression='snappy'):
+  pieces = []
+  for file_idx, a, b in ranges:
+    table = pq.read_table(files[file_idx].path)
+    pieces.append(table.slice(a, b - a))
+  if pieces:
+    out = pa.concat_tables(pieces)
+  else:
+    # An empty bin still produces a (zero-row) shard so the bin-id set stays
+    # contiguous for the loader.
+    out = pq.read_table(files[0].path).slice(0, 0) if files else pa.table({})
+  pq.write_table(out, out_path, compression=compression)
+  return out.num_rows
+
+
+def balance(input_paths, output_dir, num_shards, comm, postfix=''):
+  """Balance one group of shards (one bin, or the whole unbinned set).
+
+  Returns ``{output_basename: num_samples}`` for the shards this invocation
+  produced (identical on every rank).
+  """
+  paths = sorted(input_paths)
+  files = count_samples(paths, comm)
+  plans = plan_shards(files, num_shards)
+  meta = {}
+  for s, ranges in enumerate(plans):
+    out_name = f'shard-{s}.parquet{postfix}'
+    meta[out_name] = sum(b - a for _, a, b in ranges)
+    if s % comm.world_size == comm.rank:
+      written = _materialize_shard(files, ranges,
+                                   os.path.join(output_dir, out_name))
+      assert written == meta[out_name], (
+          f'{out_name}: wrote {written} rows, planned {meta[out_name]}')
+  comm.barrier()
+  return meta
+
+
+def balance_directory(input_dir, output_dir, num_shards, comm=None):
+  """Balance a full preprocessor sink: per-bin when binned (reference
+  ``load_balance.py:394-416``), plus the ``.num_samples.json`` cache."""
+  comm = comm or get_backend()
+  os.makedirs(output_dir, exist_ok=True)
+  paths = get_all_parquets_under(input_dir)
+  if not paths:
+    raise ValueError(f'no parquet shards under {input_dir}')
+  bin_ids = get_all_bin_ids(paths)
+  meta = {}
+  if bin_ids:
+    for b in bin_ids:
+      meta.update(
+          balance(
+              get_file_paths_for_bin_id(paths, b),
+              output_dir,
+              num_shards,
+              comm,
+              postfix=f'_{b}'))
+  else:
+    meta.update(balance(paths, output_dir, num_shards, comm))
+  if comm.rank == 0:
+    with open(os.path.join(output_dir, NUM_SAMPLES_CACHE), 'w') as f:
+      json.dump(meta, f, indent=2, sort_keys=True)
+  comm.barrier()
+  return meta
+
+
+def generate_num_samples_cache(path, comm=None):
+  """(Re)build ``.num_samples.json`` for an already-balanced directory
+  (reference ``load_balance.py:428-455``)."""
+  comm = comm or get_backend()
+  paths = get_all_parquets_under(path)
+  files = count_samples(sorted(paths), comm)
+  meta = {os.path.basename(f.path): f.num_samples for f in files}
+  if comm.rank == 0:
+    with open(os.path.join(path, NUM_SAMPLES_CACHE), 'w') as f:
+      json.dump(meta, f, indent=2, sort_keys=True)
+  comm.barrier()
+  return meta
+
+
+def load_num_samples_cache(path):
+  """Read ``.num_samples.json`` if present; returns None otherwise."""
+  cache = os.path.join(path, NUM_SAMPLES_CACHE)
+  if not os.path.isfile(cache):
+    return None
+  with open(cache) as f:
+    return json.load(f)
+
+
+def attach_args(parser):
+  parser.add_argument('--indir', type=str, required=True)
+  parser.add_argument('--outdir', type=str, required=True)
+  parser.add_argument('--num-shards', type=int, required=True)
+  parser.add_argument('--comm', type=str, default='null',
+                      choices=['null', 'file', 'jax'])
+  return parser
+
+
+def main(args=None):
+  parser = attach_args(
+      argparse.ArgumentParser(
+          description=__doc__,
+          formatter_class=argparse.ArgumentDefaultsHelpFormatter))
+  args = parser.parse_args(args)
+  comm = get_backend(args.comm)
+  t0 = time.perf_counter()
+  meta = balance_directory(args.indir, args.outdir, args.num_shards, comm)
+  if comm.rank == 0:
+    print(f'balanced {sum(meta.values())} samples into {len(meta)} shards '
+          f'in {time.perf_counter() - t0:.1f}s')
+
+
+def cache_main(args=None):
+  parser = argparse.ArgumentParser(
+      description=generate_num_samples_cache.__doc__)
+  parser.add_argument('--path', type=str, required=True)
+  parser.add_argument('--comm', type=str, default='null',
+                      choices=['null', 'file', 'jax'])
+  args = parser.parse_args(args)
+  generate_num_samples_cache(args.path, get_backend(args.comm))
+
+
+if __name__ == '__main__':
+  main()

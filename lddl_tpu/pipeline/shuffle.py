@@ -69,24 +69,30 @@ def gather_partition(tgt_index, spill_dir, seed):
   return lines
 
 
-def _scatter_corpus_task(src_idx, idx, corpus, num_targets, spill_dir, seed):
-  del idx
-  return scatter_partition(
-      corpus.read_partition(src_idx), src_idx, num_targets, spill_dir, seed)
+def _scatter_corpus_task(part_slices, idx, num_targets, spill_dir, seed,
+                         sample_ratio, sample_seed):
+  from ..preprocess.readers import read_partition_lines
+  lines = read_partition_lines(part_slices, idx, sample_ratio, sample_seed)
+  return scatter_partition(lines, idx, num_targets, spill_dir, seed)
 
 
 def shuffle_corpus(executor, corpus, spill_dir, seed, num_targets=None):
   """Shuffle a :class:`~lddl_tpu.preprocess.readers.Corpus` (honoring its
-  per-partition subsampling) into ``num_targets`` on-disk partitions."""
+  per-partition subsampling) into ``num_targets`` on-disk partitions.
+
+  Each task carries only its own partition's slices (plus scalar sampling
+  parameters), so scatter payloads stay O(1) in the number of partitions.
+  """
   if num_targets is None:
     num_targets = corpus.num_partitions
   task = functools.partial(
       _scatter_corpus_task,
-      corpus=corpus,
       num_targets=num_targets,
       spill_dir=spill_dir,
-      seed=seed)
-  executor.map(task, list(range(corpus.num_partitions)), gather=False)
+      seed=seed,
+      sample_ratio=corpus.sample_ratio,
+      sample_seed=corpus.sample_seed)
+  executor.map(task, list(corpus.partitions), gather=False)
   return num_targets
 
 

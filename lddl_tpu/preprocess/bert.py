@@ -304,6 +304,12 @@ def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
   """Execute the full preprocess: global doc shuffle -> pair/mask/bin ->
   Parquet shards under ``sink_dir``. Returns per-partition sample counts."""
   executor = executor or Executor()
+  if cfg.sentence_backend == 'auto':
+    # Resolve once and broadcast so segmentation (and thus shard content)
+    # never depends on which worker host has nltk data installed.
+    from ..tokenization.sentences import resolve_backend
+    resolved = executor.comm.broadcast_object(resolve_backend(), root=0)
+    cfg = dataclasses.replace(cfg, sentence_backend=resolved)
   os.makedirs(sink_dir, exist_ok=True)
   spill_dir = os.path.join(sink_dir, '_shuffle_spill')
   # Pre-clean stale spills (a rerun with fewer partitions or a crashed

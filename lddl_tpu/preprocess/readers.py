@@ -44,11 +44,21 @@ class Corpus:
 
   def read_partition(self, idx):
     """Yield the (possibly subsampled) raw document lines of partition idx."""
-    rng = rng_from_key(self.sample_seed, 'corpus-sample', idx)
-    for s in self.partitions[idx]:
-      for line in read_lines(s):
-        if self.sample_ratio >= 1.0 or rng.random() < self.sample_ratio:
-          yield line
+    return read_partition_lines(self.partitions[idx], idx, self.sample_ratio,
+                                self.sample_seed)
+
+
+def read_partition_lines(part_slices, idx, sample_ratio, sample_seed):
+  """Yield one partition's (possibly subsampled) document lines.
+
+  Module-level so distributed tasks can carry just their own slices plus
+  scalar sampling parameters instead of the whole corpus plan.
+  """
+  rng = rng_from_key(sample_seed, 'corpus-sample', idx)
+  for s in part_slices:
+    for line in read_lines(s):
+      if sample_ratio >= 1.0 or rng.random() < sample_ratio:
+        yield line
 
 
 def read_corpus(dirs, num_blocks=None, block_size=None, sample_ratio=1.0,

@@ -64,24 +64,35 @@ def _try_punkt():
   if _nltk_punkt is None:
     try:
       import nltk
-      nltk.data.find('tokenizers/punkt')
+      # Probe by actually segmenting: nltk's data requirements differ across
+      # versions (punkt vs punkt_tab), so a data.find() check is unreliable.
+      nltk.tokenize.sent_tokenize('Probe one. Probe two.')
       _nltk_punkt = nltk.tokenize.sent_tokenize
     except Exception:
       _nltk_punkt = False
   return _nltk_punkt
 
 
+def resolve_backend(backend='auto'):
+  """Resolve 'auto' to the concrete backend this host would use.
+
+  Pipelines must resolve once (and broadcast) before fanning out, so that
+  the segmentation — and therefore shard content — never depends on which
+  worker host happens to have nltk data installed.
+  """
+  if backend == 'auto':
+    return 'punkt' if _try_punkt() else 'rules'
+  return backend
+
+
 def split_sentences(text, backend='auto'):
   """Split a document into sentences.
 
-  backend: 'auto' (punkt when its data is installed, else rules),
+  backend: 'auto' (punkt when its data is usable, else rules),
   'punkt', or 'rules'.
   """
+  backend = resolve_backend(backend)
   if backend == 'punkt':
     import nltk
     return nltk.tokenize.sent_tokenize(text)
-  if backend == 'auto':
-    punkt = _try_punkt()
-    if punkt:
-      return punkt(text)
   return _rule_based_split(text)

@@ -1,0 +1,154 @@
+import json
+import multiprocessing as mp
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from lddl_tpu.balance import (
+    NUM_SAMPLES_CACHE,
+    balance_directory,
+    generate_num_samples_cache,
+    load_num_samples_cache,
+    plan_shards,
+)
+from lddl_tpu.comm import FileBackend, NullBackend
+from lddl_tpu.core import File, get_num_samples_of_parquet
+
+
+def _write_shard(d, name, values):
+  path = os.path.join(str(d), name)
+  pq.write_table(
+      pa.table({
+          'A': [f'v{v}' for v in values],
+          'num_tokens': pa.array(values, type=pa.uint16()),
+      }), path)
+  return path
+
+
+class TestPlan:
+
+  def test_balanced_sizes(self):
+    files = [File(f'f{i}', n) for i, n in enumerate([10, 1, 7, 0, 5])]
+    plans = plan_shards(files, 4)
+    sizes = [sum(b - a for _, a, b in p) for p in plans]
+    # 23 samples over 4 shards -> 6,6,6,5
+    assert sizes == [6, 6, 6, 5]
+
+  def test_covers_every_row_once(self):
+    files = [File(f'f{i}', n) for i, n in enumerate([3, 8, 2, 9])]
+    plans = plan_shards(files, 5)
+    seen = set()
+    for p in plans:
+      for fi, a, b in p:
+        for row in range(a, b):
+          key = (fi, row)
+          assert key not in seen
+          seen.add(key)
+    assert len(seen) == 22
+    for fi, f in enumerate(files):
+      for row in range(f.num_samples):
+        assert (fi, row) in seen
+
+  def test_more_shards_than_samples(self):
+    plans = plan_shards([File('f', 2)], 4)
+    sizes = [sum(b - a for _, a, b in p) for p in plans]
+    assert sizes == [1, 1, 0, 0]
+
+
+class TestBalanceDirectory:
+
+  def test_unbinned(self, tmp_path):
+    indir, outdir = tmp_path / 'in', tmp_path / 'out'
+    indir.mkdir()
+    _write_shard(indir, 'part.0.parquet', list(range(17)))
+    _write_shard(indir, 'part.1.parquet', list(range(3)))
+    _write_shard(indir, 'part.2.parquet', list(range(8)))
+    meta = balance_directory(str(indir), str(outdir), 4, NullBackend())
+    assert sorted(meta.values(), reverse=True) == [7, 7, 7, 7]
+    for name, n in meta.items():
+      path = os.path.join(str(outdir), name)
+      assert get_num_samples_of_parquet(path) == n
+    cache = load_num_samples_cache(str(outdir))
+    assert cache == meta
+
+  def test_binned_per_bin_balance(self, tmp_path):
+    indir, outdir = tmp_path / 'in', tmp_path / 'out'
+    indir.mkdir()
+    # bin 0: 10 samples total, bin 1: 5 samples total
+    _write_shard(indir, 'part.0.parquet_0', list(range(9)))
+    _write_shard(indir, 'part.1.parquet_0', [42])
+    _write_shard(indir, 'part.0.parquet_1', list(range(5)))
+    _write_shard(indir, 'part.1.parquet_1', [])
+    meta = balance_directory(str(indir), str(outdir), 2, NullBackend())
+    assert meta == {
+        'shard-0.parquet_0': 5,
+        'shard-1.parquet_0': 5,
+        'shard-0.parquet_1': 3,
+        'shard-1.parquet_1': 2,
+    }
+    # row content preserved: multiset of values per bin unchanged
+    vals = []
+    for name in ('shard-0.parquet_0', 'shard-1.parquet_0'):
+      vals += pq.read_table(os.path.join(str(outdir),
+                                         name)).column('num_tokens').to_pylist()
+    assert sorted(vals) == sorted(list(range(9)) + [42])
+
+  def test_preserves_schema_columns(self, tmp_path):
+    indir, outdir = tmp_path / 'in', tmp_path / 'out'
+    indir.mkdir()
+    _write_shard(indir, 'part.0.parquet', [1, 2, 3])
+    balance_directory(str(indir), str(outdir), 2, NullBackend())
+    t = pq.read_table(os.path.join(str(outdir), 'shard-0.parquet'))
+    assert t.column_names == ['A', 'num_tokens']
+
+  def test_generate_num_samples_cache(self, tmp_path):
+    _write_shard(tmp_path, 'shard-0.parquet', [1, 2])
+    _write_shard(tmp_path, 'shard-1.parquet', [3])
+    meta = generate_num_samples_cache(str(tmp_path), NullBackend())
+    assert meta == {'shard-0.parquet': 2, 'shard-1.parquet': 1}
+    with open(os.path.join(str(tmp_path), NUM_SAMPLES_CACHE)) as f:
+      assert json.load(f) == meta
+
+
+def _balance_worker(rank, world, rdzv, indir, outdir, q):
+  comm = FileBackend(rdzv, rank, world, timeout=60.0)
+  meta = balance_directory(indir, outdir, 4, comm)
+  q.put((rank, meta))
+
+
+def test_balance_two_ranks_matches_single(tmp_path):
+  indir = tmp_path / 'in'
+  indir.mkdir()
+  _write_shard(indir, 'part.0.parquet', list(range(11)))
+  _write_shard(indir, 'part.1.parquet', list(range(6)))
+  _write_shard(indir, 'part.2.parquet', list(range(14)))
+
+  out_single = tmp_path / 'out_single'
+  meta_single = balance_directory(str(indir), str(out_single), 4,
+                                  NullBackend())
+
+  world = 2
+  out_multi = tmp_path / 'out_multi'
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [
+      ctx.Process(
+          target=_balance_worker,
+          args=(r, world, str(tmp_path / 'rdzv'), str(indir), str(out_multi),
+                q)) for r in range(world)
+  ]
+  for p in procs:
+    p.start()
+  metas = {}
+  for _ in range(world):
+    rank, meta = q.get(timeout=120)
+    metas[rank] = meta
+  for p in procs:
+    p.join(timeout=60)
+    assert p.exitcode == 0
+  assert metas[0] == metas[1] == meta_single
+  for name in meta_single:
+    a = pq.read_table(os.path.join(str(out_single), name))
+    b = pq.read_table(os.path.join(str(out_multi), name))
+    assert a.equals(b)  # bit-identical plan regardless of world size
