@@ -45,24 +45,17 @@ from .core import (
     get_all_bin_ids,
     get_all_parquets_under,
     get_file_paths_for_bin_id,
-    get_num_samples_of_parquet,
 )
+from .core.utils import count_parquet_samples_strided
 
 NUM_SAMPLES_CACHE = '.num_samples.json'
 
 
 def count_samples(paths, comm):
-  """Per-file sample counts with strided ownership + allreduce.
-
-  Rank ``r`` reads footers of ``paths[r::world]``; the uint64 count vector
-  is summed across ranks (reference ``load_balance.py:226-242``).
-  """
-  counts = np.zeros((len(paths),), dtype=np.uint64)
-  for i in range(comm.rank, len(paths), comm.world_size):
-    counts[i] = get_num_samples_of_parquet(paths[i])
-  if comm.world_size > 1:
-    counts = comm.allreduce_sum(counts)
-  return [File(p, int(c)) for p, c in zip(paths, counts)]
+  """Per-file sample counts with strided ownership + allreduce
+  (reference ``load_balance.py:226-242``)."""
+  counts = count_parquet_samples_strided(paths, comm)
+  return [File(p, c) for p, c in zip(paths, counts)]
 
 
 def plan_shards(files, num_shards):
@@ -96,17 +89,34 @@ def plan_shards(files, num_shards):
   return plans
 
 
+def _read_row_range(path, a, b):
+  """Read rows [a, b) of a Parquet file, touching only the row groups that
+  overlap the range (not the whole file)."""
+  pf = pq.ParquetFile(path)
+  md = pf.metadata
+  offsets = np.cumsum(
+      [0] + [md.row_group(i).num_rows for i in range(md.num_row_groups)])
+  groups = [
+      i for i in range(md.num_row_groups)
+      if offsets[i + 1] > a and offsets[i] < b
+  ]
+  if not groups:
+    return pf.schema_arrow.empty_table()
+  table = pf.read_row_groups(groups)
+  return table.slice(a - int(offsets[groups[0]]), b - a)
+
+
 def _materialize_shard(files, ranges, out_path, compression='snappy'):
-  pieces = []
-  for file_idx, a, b in ranges:
-    table = pq.read_table(files[file_idx].path)
-    pieces.append(table.slice(a, b - a))
+  pieces = [
+      _read_row_range(files[file_idx].path, a, b) for file_idx, a, b in ranges
+  ]
   if pieces:
     out = pa.concat_tables(pieces)
   else:
     # An empty bin still produces a (zero-row) shard so the bin-id set stays
     # contiguous for the loader.
-    out = pq.read_table(files[0].path).slice(0, 0) if files else pa.table({})
+    out = (pq.read_schema(files[0].path).empty_table()
+           if files else pa.table({}))
   pq.write_table(out, out_path, compression=compression)
   return out.num_rows
 

@@ -98,6 +98,24 @@ def get_num_samples_of_parquet(path):
   return pq.ParquetFile(path).metadata.num_rows
 
 
+def count_parquet_samples_strided(paths, comm=None):
+  """Per-file sample counts via strided ownership + all-reduce.
+
+  Rank ``r`` reads the Parquet footers of ``paths[r::world]`` and the count
+  vector is summed across ranks (the collective shape of reference
+  ``lddl/dask/load_balance.py:226-242`` and ``lddl/torch/datasets.py:161-195``).
+  ``comm=None`` means a single-process world. Returns a list of ints.
+  """
+  counts = np.zeros((len(paths),), dtype=np.int64)
+  rank = comm.rank if comm is not None else 0
+  world = comm.world_size if comm is not None else 1
+  for i in range(rank, len(paths), world):
+    counts[i] = get_num_samples_of_parquet(paths[i])
+  if comm is not None and world > 1:
+    counts = comm.allreduce_sum(counts)
+  return [int(c) for c in counts]
+
+
 def serialize_np_array(a):
   """numpy array -> bytes suitable for a Parquet binary column."""
   buf = io.BytesIO()

@@ -1,0 +1,21 @@
+"""Training-time data loaders (the reference's L4 layer, unified).
+
+One JAX frontend replaces the reference's torch / torch_mp / paddle
+triplication (``lddl/torch/*``, ``lddl/torch_mp/*``, ``lddl/paddle/*``) and
+covers the union of their capabilities: balanced-shard streaming with
+deterministic shuffling, zero-communication binned iteration, dynamic or
+static MLM masking, model-parallel (dp-group) feeding, micro-batching with
+loss masks, and mid-epoch ``samples_seen`` resume.
+"""
+
+from .bert import get_bert_pretrain_data_loader
+from .binned import BinnedIterator
+from .dataset import ParquetShardDataset
+from .shuffle_buffer import ShuffleBuffer
+
+__all__ = [
+    'get_bert_pretrain_data_loader',
+    'BinnedIterator',
+    'ParquetShardDataset',
+    'ShuffleBuffer',
+]

@@ -1,0 +1,151 @@
+"""Zero-communication binned batch iteration.
+
+Capability parity: reference ``lddl/torch/dataloader.py:32-105`` (Binned)
+plus the model-parallel pull-iterator features of
+``lddl/torch_mp/dataloader.py:84-133``:
+
+  - every rank draws the next bin id via an explicitly-stated weighted
+    ``choices`` whose weights are the remaining batch counts per bin — the
+    RNG state evolves identically on all ranks, so all ranks agree on the
+    bin (and hence the compiled step shape) **with zero communication**
+    (reference draw: ``torch/dataloader.py:79-88``);
+  - exact-drain accounting: after an epoch every bin iterator must be
+    exhausted (reference assert: ``torch/dataloader.py:91``);
+  - ``samples_seen`` fast-forward for mid-epoch resume: replays the
+    weighted draws one global batch at a time to compute per-bin skip
+    counts, then lets each dataset skip whole files / slice the first one
+    (reference ``torch_mp/bert.py:426-456``, ``torch_mp/dataloader.py:84-101``);
+  - ``next_seqlen()`` lookahead so pipeline-parallel schedulers can size
+    the upcoming micro-batches before materializing them (reference
+    ``torch_mp/dataloader.py:118-119``) — with static per-bin shapes this
+    is a pure function of the drawn bin id, no peeking required.
+"""
+
+from ..core.random import choices, get_state
+
+
+class BinnedIterator:
+  """Iterates (bin_id, list_of_rows) batches for one epoch.
+
+  ``datasets``: list of :class:`ParquetShardDataset`, one per bin (a
+  single-element list for unbinned data). ``samples_per_batch_per_rank``
+  must divide each dataset's ``samples_per_rank_per_epoch`` — guaranteed
+  when the shards went through the load balancer and the usual
+  divisibility preconditions hold.
+
+  ``batches_consumed``: global batches already consumed *this epoch* (for
+  mid-epoch resume); the constructor replays that many weighted draws so
+  the RNG state, remaining counts, and per-bin skip offsets all line up
+  with where the interrupted run stopped.
+  """
+
+  def __init__(self,
+               datasets,
+               samples_per_batch_per_rank,
+               base_seed=12345,
+               epoch=0,
+               batches_consumed=0,
+               seqlen_of_bin=None):
+    self._datasets = datasets
+    self._batch = samples_per_batch_per_rank
+    self._base_seed = base_seed
+    self._epoch = epoch
+    self._seqlen_of_bin = seqlen_of_bin
+    self._remaining = []
+    for b, d in enumerate(datasets):
+      if d.samples_per_rank_per_epoch % self._batch != 0:
+        raise AssertionError(
+            f'bin {b}: {d.samples_per_rank_per_epoch} samples/rank not '
+            f'divisible by batch size {self._batch}')
+      self._remaining.append(d.samples_per_rank_per_epoch // self._batch)
+    self._rng_state = get_state(f'{base_seed}:bins:{epoch}')
+    self._pending_bin = None
+    skip = [0] * len(datasets)
+    for _ in range(batches_consumed):
+      b = self._draw()
+      self._remaining[b] -= 1
+      skip[b] += self._batch
+    self._iters = [
+        _BatchChunker(d.iter_epoch(epoch, samples_to_skip=s), self._batch)
+        for d, s in zip(datasets, skip)
+    ]
+
+  @classmethod
+  def epoch_and_offset_of(cls, datasets, samples_per_batch_per_rank,
+                          dp_world_size, samples_seen):
+    """Map a global ``samples_seen`` counter to (epoch, batches_consumed).
+
+    ``samples_seen`` counts global samples consumed since training start
+    (reference ``torch_mp/bert.py:426-456`` computes the same split).
+    """
+    global_batch = samples_per_batch_per_rank * dp_world_size
+    batches_per_epoch = sum(
+        d.samples_per_rank_per_epoch // samples_per_batch_per_rank
+        for d in datasets)
+    consumed_per_epoch = batches_per_epoch * global_batch
+    return (samples_seen // consumed_per_epoch,
+            (samples_seen % consumed_per_epoch) // global_batch)
+
+  def __len__(self):
+    return sum(self._remaining)
+
+  @property
+  def remaining_batches(self):
+    return list(self._remaining)
+
+  def _draw(self):
+    if self._pending_bin is not None:
+      b, self._pending_bin = self._pending_bin, None
+      return b
+    (b,), self._rng_state = choices(
+        range(len(self._remaining)),
+        weights=self._remaining,
+        rng_state=self._rng_state)
+    return b
+
+  def next_seqlen(self):
+    """Sequence length of the *next* batch, without materializing it."""
+    if self._pending_bin is None:
+      self._pending_bin = self._draw()
+    if self._seqlen_of_bin is None:
+      raise ValueError('seqlen_of_bin mapping not provided')
+    return self._seqlen_of_bin(self._pending_bin)
+
+  def __iter__(self):
+    while sum(self._remaining) > 0:
+      b = self._draw()
+      self._remaining[b] -= 1
+      rows = next(self._iters[b])
+      yield b, rows
+    # Exact drain: every bin's stream must be exhausted now.
+    for b, it in enumerate(self._iters):
+      try:
+        next(it)
+      except StopIteration:
+        continue
+      raise AssertionError(f'bin {b} not fully drained at epoch end')
+
+
+class _BatchChunker:
+  """Chunk a row stream into fixed-size lists; a trailing partial batch is
+
+  a hard error (it never happens post-balancer, by the divisibility
+  precondition)."""
+
+  def __init__(self, stream, batch):
+    self._stream = stream
+    self._batch = batch
+
+  def __next__(self):
+    rows = []
+    for row in self._stream:
+      rows.append(row)
+      if len(rows) == self._batch:
+        return rows
+    if rows:
+      raise AssertionError(
+          f'partial batch of {len(rows)} rows: balancer precondition broken')
+    raise StopIteration
+
+  def __iter__(self):
+    return self

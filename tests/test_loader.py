@@ -1,0 +1,302 @@
+import os
+import random
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from lddl_tpu.core.utils import serialize_np_array
+from lddl_tpu.loader import (
+    BinnedIterator,
+    ParquetShardDataset,
+    ShuffleBuffer,
+    get_bert_pretrain_data_loader,
+)
+from lddl_tpu.loader.bert import IGNORE_INDEX, split_into_micro_batches
+
+from conftest import WORDS
+
+BIN_SIZE = 64
+
+
+def _make_sample(r, bin_id, with_mask=False):
+  """One NSP pair whose num_tokens lands inside bin_id's range."""
+  lo = bin_id * BIN_SIZE + 1
+  hi = (bin_id + 1) * BIN_SIZE
+  nt = r.randrange(max(lo, 8), hi + 1)
+  na = r.randrange(2, nt - 3 - 2)
+  nb = nt - 3 - na
+  tok = lambda: r.choice(WORDS)
+  a = [tok() for _ in range(na)]
+  b = [tok() for _ in range(nb)]
+  row = {
+      'A': ' '.join(a),
+      'B': ' '.join(b),
+      'is_random_next': bool(r.getrandbits(1)),
+      'num_tokens': nt,
+  }
+  if with_mask:
+    # Mask 2 content positions of the assembled [CLS] A [SEP] B [SEP] seq.
+    cand = list(range(1, 1 + na)) + list(range(2 + na, 2 + na + nb))
+    picked = sorted(r.sample(cand, 2))
+    seq = ['[CLS]'] + a + ['[SEP]'] + b + ['[SEP]']
+    row['masked_lm_positions'] = serialize_np_array(
+        np.asarray(picked, dtype=np.uint16))
+    row['masked_lm_labels'] = ' '.join(seq[p] for p in picked)
+  return row
+
+
+def _schema(with_mask):
+  fields = [
+      ('A', pa.string()),
+      ('B', pa.string()),
+      ('is_random_next', pa.bool_()),
+      ('num_tokens', pa.uint16()),
+  ]
+  if with_mask:
+    fields += [('masked_lm_positions', pa.binary()),
+               ('masked_lm_labels', pa.string())]
+  return pa.schema(fields)
+
+
+@pytest.fixture()
+def binned_shards(tmp_path):
+  """4 files x 8 samples for each of 2 bins, balanced by construction."""
+  d = tmp_path / 'shards'
+  d.mkdir()
+  r = random.Random(7)
+  for b in range(2):
+    for f in range(4):
+      rows = [_make_sample(r, b) for _ in range(8)]
+      cols = {
+          k: pa.array([row[k] for row in rows], type=_schema(False).field(k).type)
+          for k in _schema(False).names
+      }
+      pq.write_table(pa.table(cols), str(d / f'shard-{f}.parquet_{b}'))
+  return str(d)
+
+
+class TestShuffleBuffer:
+
+  def test_permutation_and_determinism(self):
+    data = list(range(1000))
+    out1 = list(ShuffleBuffer(64, 4, random.Random(3)).shuffle_stream(data))
+    out2 = list(ShuffleBuffer(64, 4, random.Random(3)).shuffle_stream(data))
+    assert out1 == out2
+    assert sorted(out1) == data
+    assert out1 != data  # actually shuffled
+
+  def test_small_buffer(self):
+    data = list(range(10))
+    out = list(ShuffleBuffer(1, 1, random.Random(0)).shuffle_stream(data))
+    assert sorted(out) == data
+
+
+class TestParquetShardDataset:
+
+  def test_rejects_unbalanced(self, tmp_path):
+    t = pa.table({'x': list(range(5))})
+    pq.write_table(t, str(tmp_path / 'shard-0.parquet'))
+    pq.write_table(t.slice(0, 2), str(tmp_path / 'shard-1.parquet'))
+    with pytest.raises(AssertionError, match='not balanced'):
+      ParquetShardDataset([
+          str(tmp_path / 'shard-0.parquet'),
+          str(tmp_path / 'shard-1.parquet'),
+      ])
+
+  def test_rejects_indivisible_world(self, binned_shards):
+    files = sorted(
+        os.path.join(binned_shards, f) for f in os.listdir(binned_shards)
+        if f.endswith('_0'))
+    with pytest.raises(AssertionError, match='divisible'):
+      ParquetShardDataset(files, dp_rank=0, dp_world_size=3)
+
+  def test_epoch_covers_all_once(self, binned_shards):
+    files = sorted(
+        os.path.join(binned_shards, f) for f in os.listdir(binned_shards)
+        if f.endswith('_0'))
+    ds = ParquetShardDataset(files, shuffle_buffer_size=8)
+    rows = list(ds.iter_epoch(0))
+    assert len(rows) == 32
+    assert len({(r['A'], r['B']) for r in rows}) == 32
+
+  def test_rank_partition_disjoint_and_complete(self, binned_shards):
+    files = sorted(
+        os.path.join(binned_shards, f) for f in os.listdir(binned_shards)
+        if f.endswith('_0'))
+    streams = []
+    for rank in range(2):
+      ds = ParquetShardDataset(files, dp_rank=rank, dp_world_size=2)
+      streams.append(list(ds.iter_epoch(0)))
+    keys = [{(r['A'], r['B']) for r in s} for s in streams]
+    assert len(keys[0] & keys[1]) == 0
+    assert len(keys[0] | keys[1]) == 32
+
+  def test_skip_resume(self, binned_shards):
+    files = sorted(
+        os.path.join(binned_shards, f) for f in os.listdir(binned_shards)
+        if f.endswith('_1'))
+    ds = ParquetShardDataset(files, shuffle_buffer_size=4)
+    # Pre-buffer stream minus its first k elements == multiset of resumed.
+    full_prebuf = list(ds._row_stream(ds.rank_files_for_epoch(0), 0, 0))
+    k = 10
+    resumed = list(ds.iter_epoch(0, samples_to_skip=k))
+    assert len(resumed) == 32 - k
+    exp = sorted((r['A'], r['B']) for r in full_prebuf[k:])
+    got = sorted((r['A'], r['B']) for r in resumed)
+    assert exp == got
+
+
+def _mk_loader(binned_shards, tiny_vocab, **kw):
+  kw.setdefault('dp_rank', 0)
+  kw.setdefault('dp_world_size', 1)
+  kw.setdefault('batch_size_per_rank', 8)
+  kw.setdefault('bin_size', BIN_SIZE)
+  kw.setdefault('max_seq_length', 128)
+  kw.setdefault('shuffle_buffer_size', 16)
+  return get_bert_pretrain_data_loader(
+      binned_shards, vocab_file=tiny_vocab, **kw)
+
+
+class TestBertLoader:
+
+  def test_len_and_static_shapes(self, binned_shards, tiny_vocab):
+    loader = _mk_loader(binned_shards, tiny_vocab)
+    assert len(loader) == 8  # 2 bins * 32 samples / batch 8
+    seen_shapes = set()
+    n = 0
+    for batch in loader:
+      n += 1
+      assert batch['input_ids'].shape[0] == 8
+      assert batch['input_ids'].dtype == np.int32
+      s = batch['input_ids'].shape[1]
+      assert s in (64, 128)
+      seen_shapes.add(s)
+      for k in ('token_type_ids', 'attention_mask', 'labels'):
+        assert batch[k].shape == batch['input_ids'].shape
+      assert batch['next_sentence_labels'].shape == (8,)
+    assert n == 8
+    assert seen_shapes == {64, 128}
+    assert loader.epoch == 1  # epoch advanced
+
+  def test_deterministic_stream(self, binned_shards, tiny_vocab):
+    a = list(_mk_loader(binned_shards, tiny_vocab))
+    b = list(_mk_loader(binned_shards, tiny_vocab))
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+      for k in x:
+        np.testing.assert_array_equal(x[k], y[k])
+
+  def test_ranks_agree_on_bins_zero_comm(self, binned_shards, tiny_vocab):
+    streams = [
+        list(
+            _mk_loader(
+                binned_shards,
+                tiny_vocab,
+                dp_rank=r,
+                dp_world_size=2,
+                batch_size_per_rank=4)) for r in range(2)
+    ]
+    shapes0 = [b['input_ids'].shape for b in streams[0]]
+    shapes1 = [b['input_ids'].shape for b in streams[1]]
+    assert shapes0 == shapes1  # identical bin sequence on every rank
+    # but different data
+    assert not np.array_equal(streams[0][0]['input_ids'],
+                              streams[1][0]['input_ids'])
+
+  def test_dynamic_masking(self, binned_shards, tiny_vocab):
+    loader = _mk_loader(binned_shards, tiny_vocab, mlm_probability=0.3)
+    batch = next(iter(loader))
+    labels = batch['labels']
+    masked = labels != IGNORE_INDEX
+    assert masked.any()
+    # Masked positions are content positions only.
+    from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+    tok = load_bert_tokenizer(vocab_file=tiny_vocab)
+    cls_id, sep_id = tok.convert_tokens_to_ids(['[CLS]', '[SEP]'])
+    assert not ((batch['input_ids'] == cls_id) & masked).any()
+    assert (batch['attention_mask'][masked] == 1).all()
+    # ~80% of masked inputs are [MASK]
+    frac = (batch['input_ids'][masked] == tok.mask_token_id).mean()
+    assert 0.5 < frac < 1.0
+
+  def test_static_masking(self, tmp_path, tiny_vocab):
+    d = tmp_path / 'shards'
+    d.mkdir()
+    r = random.Random(11)
+    for f in range(2):
+      rows = [_make_sample(r, 0, with_mask=True) for _ in range(8)]
+      cols = {
+          k: pa.array([row[k] for row in rows], type=_schema(True).field(k).type)
+          for k in _schema(True).names
+      }
+      pq.write_table(pa.table(cols), str(d / f'shard-{f}.parquet_0'))
+    loader = get_bert_pretrain_data_loader(
+        str(d),
+        vocab_file=tiny_vocab,
+        masking='static',
+        batch_size_per_rank=4,
+        bin_size=BIN_SIZE,
+        shuffle_buffer_size=4)
+    for batch in loader:
+      masked = batch['labels'] != IGNORE_INDEX
+      assert (masked.sum(axis=1) == 2).all()
+      # Stored label == the token actually at that position (no dynamic
+      # replacement in static mode).
+      np.testing.assert_array_equal(batch['labels'][masked],
+                                    batch['input_ids'][masked])
+
+  def test_samples_seen_resume(self, binned_shards, tiny_vocab):
+    full = list(_mk_loader(binned_shards, tiny_vocab))
+    consumed = 3
+    resumed_loader = _mk_loader(
+        binned_shards, tiny_vocab, samples_seen=consumed * 8)
+    resumed = list(resumed_loader)
+    assert len(resumed) == len(full) - consumed
+    # Bin (shape) sequence of the tail is identical.
+    assert [b['input_ids'].shape for b in resumed] == \
+           [b['input_ids'].shape for b in full[consumed:]]
+
+  def test_resume_continues_collate_step_counter(self, binned_shards,
+                                                 tiny_vocab):
+    # Dynamic-mask Philox keys are keyed on the collate step; a resumed run
+    # must continue the counter, not restart at 0.
+    loader = _mk_loader(binned_shards, tiny_vocab, samples_seen=3 * 8)
+    steps = []
+    orig = loader._collate
+    loader._collate = (
+        lambda rows, s, e, st: (steps.append(st), orig(rows, s, e, st))[1])
+    list(loader)
+    assert steps == [3, 4, 5, 6, 7]
+
+  def test_micro_batches(self, binned_shards, tiny_vocab):
+    loader = _mk_loader(binned_shards, tiny_vocab, micro_batch_size=2)
+    micros = next(iter(loader))
+    assert len(micros) == 4
+    for m in micros:
+      assert m['text'].shape[0] == 2
+      assert set(m) == {
+          'text', 'types', 'padding_mask', 'is_random', 'labels', 'loss_mask'
+      }
+      np.testing.assert_array_equal(m['loss_mask'],
+                                    (m['labels'] != IGNORE_INDEX).astype(
+                                        np.float32))
+
+
+class TestBinnedIterator:
+
+  def test_exact_drain_and_epoch_offset(self, binned_shards, tiny_vocab):
+    files = sorted(
+        os.path.join(binned_shards, f) for f in os.listdir(binned_shards))
+    from lddl_tpu.core.utils import get_file_paths_for_bin_id
+    datasets = [
+        ParquetShardDataset(get_file_paths_for_bin_id(files, b))
+        for b in range(2)
+    ]
+    it = BinnedIterator(datasets, 8)
+    assert len(it) == 8
+    out = list(it)
+    assert len(out) == 8
+    epoch, off = BinnedIterator.epoch_and_offset_of(datasets, 8, 1, 8 * 8 + 24)
+    assert (epoch, off) == (1, 3)
