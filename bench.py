@@ -1,0 +1,162 @@
+"""Headline benchmark: BERT pretrain preprocessing throughput.
+
+Prints ONE JSON line:
+  {"metric": "bert_preprocess_mb_per_sec_per_chip", "value": N,
+   "unit": "MB/s/chip", "vs_baseline": N}
+
+``value`` is MB of raw one-document-per-line text turned into binned,
+masked NSP-pair Parquet shards per second per accelerator chip (the
+BASELINE.json north-star metric). ``vs_baseline`` compares against a
+faithful reimplementation of the reference's per-partition hot loop
+(per-sentence ``tokenizer.tokenize`` calls + per-token Python masking,
+reference ``lddl/dask/bert/pretrain.py:77-97,182-238``) run on the same
+corpus in the same process, so the ratio isolates the framework's
+pipeline improvements from hardware differences.
+
+Corpus size: LDDL_BENCH_MB (default 4). Baseline runs on a slice of the
+corpus and is scaled, bounded by LDDL_BENCH_BASELINE_MB (default 1).
+"""
+
+import json
+import os
+import random
+import shutil
+import tempfile
+import time
+
+_STEMS = (
+    'run walk talk jump read write think build train learn model data file '
+    'shard token mask label batch layer device host chip mesh ring core '
+    'count plan test bench load store fetch merge split join scan sort '
+    'light dark fast slow large small deep wide long short open close').split()
+_SUFFIXES = ('ing', 'ed', 'er', 'ers', 's', 'ly', 'ness', 'able')
+
+
+def _build_vocab(path):
+  tokens = ['[PAD]', '[UNK]', '[CLS]', '[SEP]', '[MASK]', '.', ',']
+  tokens += _STEMS
+  tokens += ['##' + s for s in _SUFFIXES]
+  with open(path, 'w') as f:
+    f.write('\n'.join(tokens) + '\n')
+
+
+def _gen_corpus(src_dir, target_mb, num_shards=4, seed=1234):
+  """Synthetic one-document-per-line corpus; words are stem[+suffix] so
+  WordPiece actually exercises subword matching."""
+  r = random.Random(seed)
+  target = int(target_mb * 1024 * 1024)
+  os.makedirs(src_dir, exist_ok=True)
+  written, doc_id = 0, 0
+  files = [open(os.path.join(src_dir, f'{i}.txt'), 'w') for i in range(num_shards)]
+  while written < target:
+    sents = []
+    for _ in range(r.randrange(8, 24)):
+      n = r.randrange(6, 18)
+      words = []
+      for _ in range(n):
+        w = r.choice(_STEMS)
+        if r.random() < 0.45:
+          w += r.choice(_SUFFIXES)
+        words.append(w)
+      sents.append(' '.join(words).capitalize() + '.')
+    line = f'doc-{doc_id} ' + ' '.join(sents) + '\n'
+    files[doc_id % num_shards].write(line)
+    written += len(line)
+    doc_id += 1
+  for f in files:
+    f.close()
+  return written / (1024 * 1024)
+
+
+def _reference_style_partition(lines, hf_tok, vocab_words, seed):
+  """The reference's per-partition hot loop, reimplemented faithfully:
+  per-sentence tokenize (``pretrain.py:79-91``), per-document pairing,
+  per-token masking RNG loop (``pretrain.py:182-238``)."""
+  from lddl_tpu.preprocess.bert import create_pairs_from_document, Document
+  from lddl_tpu.preprocess.readers import split_id_text
+  from lddl_tpu.tokenization import split_sentences
+
+  rng = random.Random(seed)
+  docs = []
+  for line in lines:
+    doc_id, text = split_id_text(line)
+    sents = []
+    for s in split_sentences(text, backend='rules'):
+      toks = hf_tok.tokenize(s, max_length=512, truncation=True)  # 1 call/sentence
+      if toks:
+        sents.append(tuple(toks))
+    if sents:
+      docs.append(Document(doc_id, tuple(sents)))
+  instances = []
+  for di in range(len(docs)):
+    instances.extend(
+        create_pairs_from_document(
+            docs, di, rng, masking=True, vocab_words=vocab_words))
+  return instances
+
+
+def main():
+  corpus_mb = float(os.environ.get('LDDL_BENCH_MB', '4'))
+  baseline_mb = float(os.environ.get('LDDL_BENCH_BASELINE_MB', '1'))
+  work = tempfile.mkdtemp(prefix='lddl_bench_')
+  try:
+    src = os.path.join(work, 'source')
+    vocab = os.path.join(work, 'vocab.txt')
+    _build_vocab(vocab)
+    actual_mb = _gen_corpus(src, corpus_mb)
+
+    import jax
+    num_chips = max(1, len(jax.devices()))
+
+    from lddl_tpu.pipeline.executor import Executor
+    from lddl_tpu.preprocess.bert import BertPretrainConfig, run
+    from lddl_tpu.preprocess.readers import read_corpus
+
+    cfg = BertPretrainConfig(
+        vocab_file=vocab,
+        target_seq_length=128,
+        bin_size=32,
+        duplicate_factor=1,
+        masking=True,
+        sentence_backend='rules',
+        seed=42)
+    executor = Executor()
+    corpus = read_corpus([src], num_blocks=4 * executor.num_local_workers)
+    # Warm the tokenizer (one-time transformers/torch import) outside the
+    # timed region for both measured paths; multi-GB runs amortize it.
+    from lddl_tpu.preprocess.bert import _get_tokenizer
+    _get_tokenizer(cfg).batch_tokenize(['warm up'])
+    t0 = time.perf_counter()
+    run(corpus, os.path.join(work, 'sink'), cfg, executor=executor)
+    ours_s = time.perf_counter() - t0
+    ours_mbps = actual_mb / ours_s / num_chips
+
+    # Reference-style hot loop on a corpus slice, scaled.
+    from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+    tok = load_bert_tokenizer(vocab_file=vocab)
+    lines, nbytes = [], 0
+    budget = int(baseline_mb * 1024 * 1024)
+    for name in sorted(os.listdir(src)):
+      with open(os.path.join(src, name)) as f:
+        for line in f:
+          if nbytes >= budget:
+            break
+          lines.append(line.rstrip('\n'))
+          nbytes += len(line)
+    t0 = time.perf_counter()
+    _reference_style_partition(lines, tok.hf, tok.vocab_words, seed=42)
+    ref_s = time.perf_counter() - t0
+    ref_mbps = (nbytes / (1024 * 1024)) / ref_s / num_chips
+
+    print(json.dumps({
+        'metric': 'bert_preprocess_mb_per_sec_per_chip',
+        'value': round(ours_mbps, 3),
+        'unit': 'MB/s/chip',
+        'vs_baseline': round(ours_mbps / ref_mbps, 3),
+    }))
+  finally:
+    shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == '__main__':
+  main()

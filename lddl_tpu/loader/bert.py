@@ -169,11 +169,10 @@ class BertPretrainLoader:
   the epoch counter (reference semantics: ``torch/dataloader.py:44-50``).
   """
 
-  def __init__(self, datasets, bin_ids, collate, batch_size_per_rank,
+  def __init__(self, datasets, collate, batch_size_per_rank,
                seqlen_of_bin, base_seed, start_epoch=0, batches_consumed=0,
                micro_batch_size=None):
     self._datasets = datasets
-    self._bin_ids = bin_ids
     self._collate = collate
     self._batch = batch_size_per_rank
     self._seqlen_of_bin = seqlen_of_bin
@@ -183,8 +182,12 @@ class BertPretrainLoader:
     self._micro = micro_batch_size
 
   def __len__(self):
-    return sum(d.samples_per_rank_per_epoch // self._batch
+    """Batches the *next* ``__iter__`` will yield (short on a resumed
+    mid-epoch, full afterwards) — keeps len-driven LR schedules and
+    progress bars honest across resumes."""
+    full = sum(d.samples_per_rank_per_epoch // self._batch
                for d in self._datasets)
+    return full - self._batches_consumed
 
   @property
   def samples_per_epoch(self):
@@ -292,7 +295,6 @@ def get_bert_pretrain_data_loader(
     epoch += start_epoch
   return BertPretrainLoader(
       datasets,
-      bin_ids or [None],
       collate,
       batch_size_per_rank,
       seqlen_of_bin,

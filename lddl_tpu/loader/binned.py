@@ -28,10 +28,12 @@ class BinnedIterator:
   """Iterates (bin_id, list_of_rows) batches for one epoch.
 
   ``datasets``: list of :class:`ParquetShardDataset`, one per bin (a
-  single-element list for unbinned data). ``samples_per_batch_per_rank``
-  must divide each dataset's ``samples_per_rank_per_epoch`` — guaranteed
-  when the shards went through the load balancer and the usual
-  divisibility preconditions hold.
+  single-element list for unbinned data). Each bin contributes
+  ``samples_per_rank_per_epoch // samples_per_batch_per_rank`` full
+  batches; a sub-batch leftover per bin is dropped at epoch end, the same
+  drop-leftovers semantics as the reference's end-of-epoch condition
+  (``torch_mp/dataloader.py:105``). Leftovers are deterministic across
+  ranks (all ranks truncate identically), so static batch shapes hold.
 
   ``batches_consumed``: global batches already consumed *this epoch* (for
   mid-epoch resume); the constructor replays that many weighted draws so
@@ -51,13 +53,9 @@ class BinnedIterator:
     self._base_seed = base_seed
     self._epoch = epoch
     self._seqlen_of_bin = seqlen_of_bin
-    self._remaining = []
-    for b, d in enumerate(datasets):
-      if d.samples_per_rank_per_epoch % self._batch != 0:
-        raise AssertionError(
-            f'bin {b}: {d.samples_per_rank_per_epoch} samples/rank not '
-            f'divisible by batch size {self._batch}')
-      self._remaining.append(d.samples_per_rank_per_epoch // self._batch)
+    self._remaining = [
+        d.samples_per_rank_per_epoch // self._batch for d in datasets
+    ]
     self._rng_state = get_state(f'{base_seed}:bins:{epoch}')
     self._pending_bin = None
     skip = [0] * len(datasets)
@@ -104,7 +102,11 @@ class BinnedIterator:
     return b
 
   def next_seqlen(self):
-    """Sequence length of the *next* batch, without materializing it."""
+    """Sequence length of the *next* batch, without materializing it;
+    None once the epoch is exhausted (the lookahead-past-the-end call
+    every pipeline scheduler makes)."""
+    if sum(self._remaining) == 0 and self._pending_bin is None:
+      return None
     if self._pending_bin is None:
       self._pending_bin = self._draw()
     if self._seqlen_of_bin is None:
@@ -117,7 +119,8 @@ class BinnedIterator:
       self._remaining[b] -= 1
       rows = next(self._iters[b])
       yield b, rows
-    # Exact drain: every bin's stream must be exhausted now.
+    # Exact drain: no bin may have a *full* batch left (a sub-batch
+    # leftover is the documented drop-last tail).
     for b, it in enumerate(self._iters):
       try:
         next(it)
@@ -127,10 +130,9 @@ class BinnedIterator:
 
 
 class _BatchChunker:
-  """Chunk a row stream into fixed-size lists; a trailing partial batch is
+  """Chunk a row stream into fixed-size lists, dropping a trailing
 
-  a hard error (it never happens post-balancer, by the divisibility
-  precondition)."""
+  partial batch (deterministic drop-last; static batch shapes)."""
 
   def __init__(self, stream, batch):
     self._stream = stream
@@ -142,9 +144,6 @@ class _BatchChunker:
       rows.append(row)
       if len(rows) == self._batch:
         return rows
-    if rows:
-      raise AssertionError(
-          f'partial batch of {len(rows)} rows: balancer precondition broken')
     raise StopIteration
 
   def __iter__(self):

@@ -1,0 +1,11 @@
+"""Model zoo: JAX/flax models the framework's loaders feed.
+
+The reference ships no models (LDDL is a data library; its consumers are
+BERT/BART/CodeBERT trainers elsewhere). Here a flagship BERT-pretraining
+model is first-class so the full pipeline — preprocess, balance, load,
+sharded train step — runs end-to-end inside one framework.
+"""
+
+from .bert import BertConfig, BertForPretraining, spec_for_param
+
+__all__ = ['BertConfig', 'BertForPretraining', 'spec_for_param']

@@ -1,0 +1,14 @@
+"""Device-mesh parallelism: mesh construction, sharding rules, ring
+attention for sequence/context parallelism, and the sharded train step.
+
+The reference has no model-side parallelism of its own — its torch_mp
+frontend merely *feeds* Megatron TP/PP groups (reference
+``lddl/torch_mp/bert.py:217-223``). Here the training side is first-class:
+a ``jax.sharding.Mesh`` with data / fsdp / tensor / sequence axes, XLA
+collectives over ICI, and ring attention for long-context scaling.
+"""
+
+from .mesh import MESH_AXES, batch_pspec, make_mesh
+from .ring import ring_attention
+
+__all__ = ['MESH_AXES', 'batch_pspec', 'make_mesh', 'ring_attention']

@@ -1,0 +1,64 @@
+"""Mesh construction and canonical sharding specs.
+
+Axes (any can be size 1):
+  data    pure data parallelism (gradients all-reduced over ICI/DCN)
+  fsdp    data parallelism with parameter/optimizer sharding (ZeRO-style);
+          batch is sharded over (data, fsdp) jointly
+  tensor  tensor (Megatron-style) parallelism inside attention/MLP blocks
+  seq     sequence/context parallelism: activations sharded along sequence,
+          attention runs as a ring over this axis
+
+The reference's dp_rank-feeding contract (all model-parallel ranks of one
+data-parallel group receive identical batches, ``torch_mp/bert.py:217-223``)
+holds here by construction: the loader shards batches as
+``P(('data','fsdp'), 'seq')`` and XLA replicates them over ``tensor``.
+"""
+
+import collections
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ('data', 'fsdp', 'tensor', 'seq')
+
+
+def make_mesh(data=1, fsdp=1, tensor=1, seq=1, devices=None):
+  """Build a Mesh over ``devices`` (default: all).
+
+  Any leftover device factor is folded into ``data`` when data is left at
+  its default, so ``make_mesh()`` is pure data parallelism over every chip.
+  Axis order puts ``tensor`` and ``seq`` innermost, where ICI neighbors
+  are, so the high-bandwidth collectives (tensor all-reduces, ring
+  permutes) ride the fastest links.
+  """
+  devices = np.asarray(devices if devices is not None else jax.devices())
+  n = devices.size
+  model = fsdp * tensor * seq
+  if data == 1 and n % model == 0:
+    data = n // model
+  if data * model != n:
+    raise ValueError(
+        f'mesh data={data} fsdp={fsdp} tensor={tensor} seq={seq} != {n} '
+        'devices')
+  return Mesh(
+      devices.reshape(data, fsdp, tensor, seq), MESH_AXES)
+
+
+def batch_pspec(ndim=2, seq_dim=1):
+  """PartitionSpec for a [batch, seq, ...] array: batch over (data, fsdp),
+  sequence over seq, trailing dims replicated."""
+  spec = [None] * ndim
+  spec[0] = ('data', 'fsdp')
+  if seq_dim is not None and ndim > seq_dim:
+    spec[seq_dim] = 'seq'
+  return P(*spec)
+
+
+def batch_sharding(mesh, ndim=2, seq_dim=1):
+  return NamedSharding(mesh, batch_pspec(ndim, seq_dim))
+
+
+def mesh_summary(mesh):
+  shape = collections.OrderedDict(zip(mesh.axis_names, mesh.devices.shape))
+  return ', '.join(f'{k}={v}' for k, v in shape.items())

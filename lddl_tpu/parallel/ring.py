@@ -1,0 +1,105 @@
+"""Ring attention: exact attention over a sequence-sharded mesh axis.
+
+Long-context support the reference lacks entirely (SURVEY.md §2.2: no
+sequence/context parallelism anywhere in LDDL). Each device holds a
+``[batch, heads, seq/N, head_dim]`` shard of Q, K, V; K/V blocks (and the
+key-side mask) rotate around the ``seq`` ring via ``lax.ppermute`` over
+ICI neighbors while a streaming log-sum-exp accumulator keeps the softmax
+exact — full K/V is never materialized on any chip, so max sequence length
+scales linearly with the ring size at constant per-chip memory.
+
+Numerics: scores and accumulators run in float32 regardless of input
+dtype (bfloat16 Q/K/V is fine); output is cast back to the input dtype.
+
+Usage: call :func:`ring_attention` *inside* ``jax.shard_map`` (it uses the
+collective axis name), or use :func:`make_ring_attention` to wrap it for a
+mesh and call it from jitted GSPMD code.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def _block_attn(q, k, v, bias, scale):
+  """One block's contribution: returns (scores_max, exp_scores @ v, denom)."""
+  s = jnp.einsum('bhqd,bhkd->bhqk', q, k, preferred_element_type=jnp.float32)
+  s = s * scale
+  if bias is not None:
+    s = s + bias
+  m = jnp.max(s, axis=-1, keepdims=True)
+  p = jnp.exp(s - m)
+  o = jnp.einsum('bhqk,bhkd->bhqd', p, v.astype(jnp.float32))
+  return m, o, jnp.sum(p, axis=-1, keepdims=True)
+
+
+def ring_attention(q, k, v, kv_mask=None, axis_name='seq'):
+  """Exact softmax attention with K/V sharded along ``axis_name``.
+
+  Shapes (per-device shards): q,k,v ``[b, h, s_block, d]``; ``kv_mask``
+  ``[b, s_block]`` with 1 = attend, 0 = padding (it rotates with K/V).
+  Must run inside ``shard_map`` with ``axis_name`` bound.
+  """
+  n = lax.axis_size(axis_name)
+  scale = 1.0 / (q.shape[-1] ** 0.5)
+  qf = q.astype(jnp.float32)
+  neg = jnp.float32(-1e9)
+
+  def bias_of(mask):
+    if mask is None:
+      return None
+    return jnp.where(mask, 0.0, neg)[:, None, None, :].astype(jnp.float32)
+
+  perm = [(i, (i + 1) % n) for i in range(n)]
+
+  def body(i, carry):
+    del i
+    k_blk, v_blk, mask_blk, m_acc, o_acc, l_acc = carry
+    m_blk, o_blk, l_blk = _block_attn(qf, k_blk, v_blk, bias_of(mask_blk),
+                                      scale)
+    m_new = jnp.maximum(m_acc, m_blk)
+    alpha = jnp.exp(m_acc - m_new)
+    beta = jnp.exp(m_blk - m_new)
+    o_acc = o_acc * alpha + o_blk * beta
+    l_acc = l_acc * alpha + l_blk * beta
+    k_blk = lax.ppermute(k_blk, axis_name, perm)
+    v_blk = lax.ppermute(v_blk, axis_name, perm)
+    if mask_blk is not None:
+      mask_blk = lax.ppermute(mask_blk, axis_name, perm)
+    return k_blk, v_blk, mask_blk, m_new, o_acc, l_acc
+
+  b, h, s, d = q.shape
+  m0 = jnp.full((b, h, s, 1), -jnp.inf, dtype=jnp.float32)
+  o0 = jnp.zeros((b, h, s, d), dtype=jnp.float32)
+  l0 = jnp.zeros((b, h, s, 1), dtype=jnp.float32)
+  carry = (k, v, kv_mask, m0, o0, l0)
+  if n == 1:
+    carry = body(0, carry)
+    _, _, _, _, o_acc, l_acc = carry
+  else:
+    _, _, _, _, o_acc, l_acc = lax.fori_loop(0, n, body, carry)
+  return (o_acc / jnp.maximum(l_acc, 1e-20)).astype(q.dtype)
+
+
+def make_ring_attention(mesh, q_spec=None, mask_spec=None, axis_name='seq'):
+  """Wrap :func:`ring_attention` in ``shard_map`` for use from jitted code.
+
+  ``q_spec`` defaults to ``P(('data','fsdp'), 'tensor', 'seq', None)`` —
+  batch over dp, heads over tensor parallelism, sequence over the ring.
+  """
+  q_spec = q_spec or P(('data', 'fsdp'), 'tensor', axis_name, None)
+  mask_spec = mask_spec or P(('data', 'fsdp'), axis_name)
+
+  @functools.partial(
+      jax.shard_map,
+      mesh=mesh,
+      in_specs=(q_spec, q_spec, q_spec, mask_spec),
+      out_specs=q_spec,
+      check_vma=False)
+  def _sharded(q, k, v, kv_mask):
+    return ring_attention(q, k, v, kv_mask, axis_name=axis_name)
+
+  return _sharded

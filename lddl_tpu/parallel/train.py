@@ -1,0 +1,120 @@
+"""Sharded BERT-pretraining train step.
+
+The reference stops at the DataLoader boundary; its consumers (NVIDIA BERT
+training recipes) own the step. Here the step is part of the framework so
+the binned loader's static-shape contract can be demonstrated end-to-end:
+one jitted program per bin shape, params laid out by
+:func:`lddl_tpu.models.spec_for_param` over the
+(data, fsdp, tensor, seq) mesh, gradients reduced by GSPMD over ICI.
+
+Loss = masked-LM cross entropy (ignore label -100, mean over masked
+positions) + next-sentence-prediction cross entropy — the standard BERT
+pretraining objective over exactly the dict the loader yields.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..loader.bert import IGNORE_INDEX
+from ..models import spec_for_param
+from .mesh import batch_pspec
+
+
+def param_shardings(mesh, abs_params):
+  """NamedSharding tree for a (possibly abstract) param tree."""
+  flat = jax.tree_util.tree_flatten_with_path(abs_params)[0]
+  tree = jax.tree_util.tree_structure(abs_params)
+  shardings = [
+      NamedSharding(mesh,
+                    spec_for_param([getattr(k, 'key', k) for k in path],
+                                   leaf.shape)) for path, leaf in flat
+  ]
+  return jax.tree_util.tree_unflatten(tree, shardings)
+
+
+def init_params(model, mesh, rng, seq_len=128, batch=2):
+  """Initialize params directly into their mesh placement: the init
+  computation is jitted with ``out_shardings`` so no single device ever
+  holds the full parameter set."""
+  dummy = {
+      'input_ids': jnp.zeros((batch, seq_len), jnp.int32),
+      'token_type_ids': jnp.zeros((batch, seq_len), jnp.int32),
+      'attention_mask': jnp.ones((batch, seq_len), jnp.int32),
+  }
+
+  def init_fn():
+    return model.init(rng, dummy['input_ids'], dummy['token_type_ids'],
+                      dummy['attention_mask'])['params']
+
+  abs_params = jax.eval_shape(init_fn)
+  shardings = param_shardings(mesh, abs_params)
+  return jax.jit(init_fn, out_shardings=shardings)()
+
+
+def pretrain_loss(model, params, batch, dropout_rng=None):
+  """Scalar loss + metrics dict for one batch."""
+  deterministic = dropout_rng is None
+  rngs = None if deterministic else {'dropout': dropout_rng}
+  mlm_logits, nsp_logits = model.apply(
+      {'params': params},
+      batch['input_ids'],
+      batch['token_type_ids'],
+      batch['attention_mask'],
+      deterministic=deterministic,
+      rngs=rngs)
+  labels = batch['labels']
+  masked = labels != IGNORE_INDEX
+  safe_labels = jnp.where(masked, labels, 0)
+  mlm_ce = optax.softmax_cross_entropy_with_integer_labels(
+      mlm_logits, safe_labels)
+  denom = jnp.maximum(masked.sum(), 1)
+  mlm_loss = jnp.where(masked, mlm_ce, 0.0).sum() / denom
+  nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
+      nsp_logits, batch['next_sentence_labels']).mean()
+  mlm_acc = jnp.where(masked,
+                      jnp.argmax(mlm_logits, -1) == labels, False).sum() / denom
+  return mlm_loss + nsp_loss, {
+      'mlm_loss': mlm_loss,
+      'nsp_loss': nsp_loss,
+      'mlm_acc': mlm_acc,
+  }
+
+
+def make_train_step(model, tx, mesh):
+  """Returns ``step(params, opt_state, rng, batch) ->
+  (params, opt_state, metrics)``, jitted with donated state.
+
+  Batches arrive sharded ``P(('data','fsdp'), 'seq')`` (the loader's
+  device pipeline does this); params carry their own shardings from
+  :func:`init_params`, so jit needs no in_shardings — placement is taken
+  from the arguments and GSPMD inserts every collective.
+  """
+
+  @functools.partial(jax.jit, donate_argnums=(0, 1))
+  def step(params, opt_state, rng, batch):
+    rng = jax.random.fold_in(rng, opt_state[0].count
+                             if hasattr(opt_state[0], 'count') else 0)
+
+    def loss_fn(p):
+      return pretrain_loss(model, p, batch, dropout_rng=rng)
+
+    (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    metrics['loss'] = loss
+    return params, opt_state, metrics
+
+  return step
+
+
+def shard_batch(batch, mesh):
+  """Place a host batch dict onto the mesh with the canonical data layout."""
+  return {
+      k: jax.device_put(
+          v, NamedSharding(mesh, batch_pspec(v.ndim, 1 if v.ndim > 1 else None)))
+      for k, v in batch.items()
+  }

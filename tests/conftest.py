@@ -6,11 +6,19 @@ Must run before jax is first imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+# Force CPU regardless of the ambient JAX_PLATFORMS (the machine may pin a
+# real TPU platform, and pytest's plugin autoload can import jax before this
+# file's env vars would be read): tests need the 8-device virtual mesh and
+# tight float32 numerics, not one bf16 TPU chip.
+os.environ['JAX_PLATFORMS'] = 'cpu'
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
   os.environ['XLA_FLAGS'] = (
       _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')
 
 import pytest  # noqa: E402
 

@@ -286,17 +286,45 @@ class TestBertLoader:
 
 class TestBinnedIterator:
 
-  def test_exact_drain_and_epoch_offset(self, binned_shards, tiny_vocab):
+  def _datasets(self, binned_shards):
     files = sorted(
         os.path.join(binned_shards, f) for f in os.listdir(binned_shards))
     from lddl_tpu.core.utils import get_file_paths_for_bin_id
-    datasets = [
+    return [
         ParquetShardDataset(get_file_paths_for_bin_id(files, b))
         for b in range(2)
     ]
+
+  def test_exact_drain_and_epoch_offset(self, binned_shards):
+    datasets = self._datasets(binned_shards)
     it = BinnedIterator(datasets, 8)
     assert len(it) == 8
     out = list(it)
     assert len(out) == 8
     epoch, off = BinnedIterator.epoch_and_offset_of(datasets, 8, 1, 8 * 8 + 24)
     assert (epoch, off) == (1, 3)
+
+  def test_drop_last_partial_batches(self, binned_shards):
+    datasets = self._datasets(binned_shards)
+    # 32 samples per bin, batch 5 -> 6 full batches per bin, 2 dropped.
+    it = BinnedIterator(datasets, 5)
+    assert len(it) == 12
+    out = list(it)
+    assert len(out) == 12
+    assert all(len(rows) == 5 for _, rows in out)
+
+  def test_next_seqlen_lookahead_and_end(self, binned_shards):
+    datasets = self._datasets(binned_shards)
+    it = BinnedIterator(datasets, 8, seqlen_of_bin=lambda b: (b + 1) * 64)
+    stream = iter(it)
+    for _ in range(len(it)):
+      s = it.next_seqlen()
+      b, rows = next(stream)
+      assert s == (b + 1) * 64
+    assert it.next_seqlen() is None  # one past the end: sentinel, not crash
+
+  def test_resumed_loader_len(self, binned_shards, tiny_vocab):
+    loader = _mk_loader(binned_shards, tiny_vocab, samples_seen=3 * 8)
+    assert len(loader) == 5
+    assert len(list(loader)) == 5
+    assert len(loader) == 8  # full again after the resumed epoch
