@@ -1,0 +1,88 @@
+"""Console entry points (reference ``setup.py:63-74``'s 8 scripts + the
+fork's CodeBERT wrapper), all thin delegates:
+
+  download_wikipedia / download_books / download_common_crawl /
+  download_open_webtext          -> lddl_tpu.download.*
+  preprocess_bert_pretrain       -> lddl_tpu.preprocess.bert
+  preprocess_bart_pretrain       -> lddl_tpu.preprocess.bart
+  preprocess_codebert_pretrain   -> lddl_tpu.preprocess.codebert
+  balance_shards                 -> lddl_tpu.balance   (reference name:
+                                    balance_dask_output)
+  generate_num_samples_cache     -> lddl_tpu.balance
+
+Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
+installed console scripts.
+"""
+
+import sys
+
+
+def download_wikipedia(args=None):
+  from .download.wikipedia import main
+  main(args)
+
+
+def download_books(args=None):
+  from .download.books import main
+  main(args)
+
+
+def download_common_crawl(args=None):
+  from .download.common_crawl import main
+  main(args)
+
+
+def download_open_webtext(args=None):
+  from .download.openwebtext import main
+  main(args)
+
+
+def preprocess_bert_pretrain(args=None):
+  from .preprocess.bert import main
+  main(args)
+
+
+def preprocess_bart_pretrain(args=None):
+  from .preprocess.bart import main
+  main(args)
+
+
+def preprocess_codebert_pretrain(args=None):
+  from .preprocess.codebert import main
+  main(args)
+
+
+def balance_shards(args=None):
+  from .balance import main
+  main(args)
+
+
+def generate_num_samples_cache(args=None):
+  from .balance import cache_main
+  cache_main(args)
+
+
+_COMMANDS = {
+    'download_wikipedia': download_wikipedia,
+    'download_books': download_books,
+    'download_common_crawl': download_common_crawl,
+    'download_open_webtext': download_open_webtext,
+    'preprocess_bert_pretrain': preprocess_bert_pretrain,
+    'preprocess_bart_pretrain': preprocess_bart_pretrain,
+    'preprocess_codebert_pretrain': preprocess_codebert_pretrain,
+    'balance_shards': balance_shards,
+    'generate_num_samples_cache': generate_num_samples_cache,
+}
+
+
+def main():
+  if len(sys.argv) < 2 or sys.argv[1] not in _COMMANDS:
+    names = '\n  '.join(sorted(_COMMANDS))
+    print(f'usage: python -m lddl_tpu.cli <command> [args...]\n'
+          f'commands:\n  {names}')
+    return 2
+  return _COMMANDS[sys.argv[1]](sys.argv[2:])
+
+
+if __name__ == '__main__':
+  sys.exit(main())

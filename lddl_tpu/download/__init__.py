@@ -1,0 +1,16 @@
+"""Corpus downloaders (host-side, L1 of the reference's layer map).
+
+Each downloader is a multi-step CLI (``--no-download`` / ``--no-extract`` /
+``--no-shard`` toggles, reference pattern ``lddl/download/*``) whose
+contract is a ``source/`` directory of ``.txt`` shards, one document per
+line, first whitespace-separated token = document id — exactly what the
+:mod:`lddl_tpu.preprocess` readers consume.
+
+Heavy external fetchers (wikiextractor, news-please, gdown) are gated at
+call time with clear errors when absent, so the extraction/sharding logic
+stays importable and testable on egress-restricted machines.
+"""
+
+from .utils import download_file, shard_documents
+
+__all__ = ['download_file', 'shard_documents']

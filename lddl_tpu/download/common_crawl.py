@@ -1,0 +1,132 @@
+"""Common Crawl news downloader: news-please crawl -> article shards.
+
+Capability parity: reference ``lddl/download/common_crawl.py`` (news-please
+``commoncrawl_crawler`` over CC-NEWS WARCs with date/language filters, a
+streaming article writer, then shard aggregation; reference
+``common_crawl.py:326-483``). The crawler dependency is gated; the article
+sink + sharding are plain functions so the pipeline stays testable.
+"""
+
+import argparse
+import datetime
+import glob
+import os
+import threading
+
+from ..core import attach_bool_arg
+from .utils import shard_documents
+
+
+class ArticleSink:
+  """Thread-safe streaming writer: news-please invokes the callback from
+  many threads; each thread appends to its own spool file (the reference
+  uses the same thread-local layout, ``common_crawl.py:310-352``)."""
+
+  def __init__(self, spool_dir, articles_per_flush=512):
+    self._dir = spool_dir
+    os.makedirs(spool_dir, exist_ok=True)
+    self._local = threading.local()
+    self._per_flush = articles_per_flush
+    self._count = 0
+    self._lock = threading.Lock()
+    self._all_buffers = []  # [(buf, path)] so a final flush sees every thread
+
+  def _thread_buffer(self):
+    buf = getattr(self._local, 'buf', None)
+    if buf is None:
+      self._local.buf = buf = []
+      self._local.path = os.path.join(
+          self._dir, f'articles-{threading.get_ident()}.txt')
+      with self._lock:
+        self._all_buffers.append((buf, self._local.path))
+    return buf
+
+  def __call__(self, article):
+    text = getattr(article, 'maintext', None) or ''
+    title = getattr(article, 'title', '') or ''
+    if not text:
+      return
+    buf = self._thread_buffer()
+    with self._lock:
+      self._count += 1
+      idx = self._count
+    one_line = ' '.join((title + ' ' + text).split())
+    buf.append(f'ccnews-{idx} {one_line}\n')
+    if len(buf) >= self._per_flush:
+      self._write(buf, self._local.path)
+
+  @staticmethod
+  def _write(buf, path):
+    with open(path, 'a', encoding='utf-8') as f:
+      f.writelines(buf)
+    buf.clear()
+
+  def flush(self):
+    """Flush every thread's pending buffer (call once after the crawl)."""
+    with self._lock:
+      for buf, path in self._all_buffers:
+        if buf:
+          self._write(buf, path)
+
+
+def crawl(spool_dir, start_date, end_date, languages=('en',),
+          articles_per_flush=512):
+  try:
+    from newsplease.crawler import commoncrawl_crawler
+  except ImportError:
+    raise RuntimeError(
+        'news-please is not installed; install it or provide pre-crawled '
+        'article files and rerun with --no-crawl')
+  sink = ArticleSink(spool_dir, articles_per_flush)
+  commoncrawl_crawler.crawl_from_commoncrawl(
+      sink,
+      valid_hosts=None,
+      start_date=start_date,
+      end_date=end_date,
+      language=list(languages),
+  )
+  sink.flush()
+
+
+def read_spools(spool_dir):
+  """Yield (doc_id, text) back out of the spool files."""
+  for p in sorted(glob.glob(os.path.join(spool_dir, 'articles-*.txt'))):
+    with open(p, encoding='utf-8') as f:
+      for line in f:
+        parts = line.split(None, 1)
+        if len(parts) == 2:
+          yield parts[0], parts[1]
+
+
+def attach_args(parser):
+  parser.add_argument('--outdir', type=str, required=True)
+  parser.add_argument('--start-date', type=str, default='2020-01-01')
+  parser.add_argument('--end-date', type=str, default='2020-02-01')
+  parser.add_argument('--langs', type=str, default='en',
+                      help='comma-separated language codes')
+  parser.add_argument('--num-shards', type=int, default=256)
+  attach_bool_arg(parser, 'crawl', default=True)
+  attach_bool_arg(parser, 'shard', default=True)
+  return parser
+
+
+def main(args=None):
+  parser = attach_args(argparse.ArgumentParser(description=__doc__))
+  args = parser.parse_args(args)
+  outdir = os.path.abspath(os.path.expanduser(args.outdir))
+  spool = os.path.join(outdir, 'spool')
+  source = os.path.join(outdir, 'source')
+  if args.crawl:
+    crawl(
+        spool,
+        datetime.datetime.fromisoformat(args.start_date),
+        datetime.datetime.fromisoformat(args.end_date),
+        languages=args.langs.split(','))
+  if args.shard:
+    counts = shard_documents(read_spools(spool), source, args.num_shards)
+    print(f'sharded {sum(counts)} articles into {len(counts)} shards '
+          f'under {source}')
+
+
+if __name__ == '__main__':
+  main()

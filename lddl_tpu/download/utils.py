@@ -1,0 +1,71 @@
+"""Shared downloader helpers.
+
+``download_file``: streaming HTTP download with a progress meter
+(reference ``lddl/download/utils.py:30-39``). ``shard_documents``: write
+an iterator of (doc_id, one_line_text) into N round-robin ``.txt`` shards
+— the common final step of every downloader (reference per-corpus
+variants: ``wikipedia.py:48-85``, ``books.py:163-187``,
+``openwebtext.py:106-167``).
+"""
+
+import os
+
+
+def download_file(url, path, chunk_size=16 * 1024 * 1024, quiet=False):
+  """Stream ``url`` to ``path`` (skips if already fully present)."""
+  import requests
+  if os.path.isfile(path):
+    head = requests.head(url, allow_redirects=True, timeout=60)
+    size = int(head.headers.get('content-length', -1))
+    if size == os.path.getsize(path):
+      if not quiet:
+        print(f'{path} already downloaded')
+      return path
+  os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+  tmp = path + '.tmp'
+  with requests.get(url, stream=True, timeout=60) as r:
+    r.raise_for_status()
+    total = int(r.headers.get('content-length', 0))
+    done = 0
+    with open(tmp, 'wb') as f:
+      for chunk in r.iter_content(chunk_size=chunk_size):
+        f.write(chunk)
+        done += len(chunk)
+        if not quiet and total:
+          print(f'\r{path}: {done / 1e6:.0f}/{total / 1e6:.0f} MB', end='')
+  if not quiet:
+    print()
+  os.replace(tmp, path)
+  return path
+
+
+def _sanitize_one_line(text):
+  """Flatten a document to a single line (the one-doc-per-line contract)."""
+  return ' '.join(text.split())
+
+
+def shard_documents(docs, outdir, num_shards):
+  """Round-robin (doc_id, text) documents into ``num_shards`` txt shards.
+
+  Returns per-shard document counts. Documents are flattened to one line;
+  empties are dropped.
+  """
+  os.makedirs(outdir, exist_ok=True)
+  files = [
+      open(os.path.join(outdir, f'{i}.txt'), 'w', encoding='utf-8')
+      for i in range(num_shards)
+  ]
+  counts = [0] * num_shards
+  try:
+    i = 0
+    for doc_id, text in docs:
+      line = _sanitize_one_line(text)
+      if not line:
+        continue
+      files[i % num_shards].write(f'{doc_id} {line}\n')
+      counts[i % num_shards] += 1
+      i += 1
+  finally:
+    for f in files:
+      f.close()
+  return counts

@@ -10,11 +10,13 @@ loss masks, and mid-epoch ``samples_seen`` resume.
 
 from .bert import get_bert_pretrain_data_loader
 from .binned import BinnedIterator
+from .codebert import get_codebert_pretrain_data_loader
 from .dataset import ParquetShardDataset
 from .shuffle_buffer import ShuffleBuffer
 
 __all__ = [
     'get_bert_pretrain_data_loader',
+    'get_codebert_pretrain_data_loader',
     'BinnedIterator',
     'ParquetShardDataset',
     'ShuffleBuffer',

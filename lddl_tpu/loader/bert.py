@@ -51,8 +51,10 @@ class BertCollate:
     self._mlm_prob = mlm_probability
     self._base_seed = base_seed
     self._dp_rank = dp_rank
-    self._cls_id, self._sep_id = tokenizer.convert_tokens_to_ids(
-        ['[CLS]', '[SEP]'])
+    # Resolved through the tokenizer's own special-token config, not
+    # hardcoded names, so BPE vocabs (<s>/</s>, e.g. codebert-base) work.
+    self._cls_id = tokenizer.cls_token_id
+    self._sep_id = tokenizer.sep_token_id
     self._mask_id = tokenizer.mask_token_id
     self._pad_id = tokenizer.pad_token_id or 0
     self._vocab_size = tokenizer.vocab_size
@@ -221,6 +223,65 @@ class BertPretrainLoader:
     self.epoch += 1
 
 
+def build_pretrain_loader(
+    path,
+    collate,
+    dp_rank=0,
+    dp_world_size=1,
+    batch_size_per_rank=64,
+    max_seq_length=512,
+    bin_size=None,
+    sequence_length_alignment=8,
+    shuffle_buffer_size=16384,
+    shuffle_buffer_warmup_factor=16,
+    base_seed=12345,
+    start_epoch=0,
+    samples_seen=0,
+    micro_batch_size=None,
+    comm=None,
+):
+  """Shared wiring for pretrain loaders: shard/bin discovery, per-bin
+  datasets, static seq-len mapping, and samples_seen resume placement."""
+  comm = comm or get_backend()
+  files = get_all_parquets_under(path)
+  if not files:
+    raise ValueError(f'no parquet shards under {path}')
+  bin_ids = get_all_bin_ids(files)
+  mk = lambda fs: ParquetShardDataset(
+      fs,
+      dp_rank=dp_rank,
+      dp_world_size=dp_world_size,
+      shuffle_buffer_size=shuffle_buffer_size,
+      shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+      base_seed=base_seed,
+      comm=comm)
+  if bin_ids:
+    if bin_size is None:
+      raise ValueError('binned shards require bin_size')
+    datasets = [mk(get_file_paths_for_bin_id(files, b)) for b in bin_ids]
+    seqlen_of_bin = lambda i: min(
+        _align_up(bin_size * (bin_ids[i] + 1), sequence_length_alignment),
+        max_seq_length)
+  else:
+    datasets = [mk(files)]
+    seqlen_of_bin = lambda i: max_seq_length
+
+  epoch, consumed = start_epoch, 0
+  if samples_seen:
+    epoch, consumed = BinnedIterator.epoch_and_offset_of(
+        datasets, batch_size_per_rank, dp_world_size, samples_seen)
+    epoch += start_epoch
+  return BertPretrainLoader(
+      datasets,
+      collate,
+      batch_size_per_rank,
+      seqlen_of_bin,
+      base_seed,
+      start_epoch=epoch,
+      batches_consumed=consumed,
+      micro_batch_size=micro_batch_size)
+
+
 def get_bert_pretrain_data_loader(
     path,
     dp_rank=0,
@@ -255,50 +316,25 @@ def get_bert_pretrain_data_loader(
     from ..tokenization.wordpiece import load_bert_tokenizer
     tokenizer = load_bert_tokenizer(
         vocab_file=vocab_file, hub_name=tokenizer_name, lowercase=lowercase)
-  comm = comm or get_backend()
-  files = get_all_parquets_under(path)
-  if not files:
-    raise ValueError(f'no parquet shards under {path}')
-  bin_ids = get_all_bin_ids(files)
-  mk = lambda fs: ParquetShardDataset(
-      fs,
-      dp_rank=dp_rank,
-      dp_world_size=dp_world_size,
-      shuffle_buffer_size=shuffle_buffer_size,
-      shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
-      base_seed=base_seed,
-      comm=comm)
-  if bin_ids:
-    if bin_size is None:
-      raise ValueError('binned shards require bin_size')
-    datasets = [
-        mk(get_file_paths_for_bin_id(files, b)) for b in bin_ids
-    ]
-    seqlen_of_bin = lambda i: min(
-        _align_up(bin_size * (bin_ids[i] + 1), sequence_length_alignment),
-        max_seq_length)
-  else:
-    datasets = [mk(files)]
-    seqlen_of_bin = lambda i: max_seq_length
-
   collate = BertCollate(
       tokenizer,
       masking=masking,
       mlm_probability=mlm_probability,
       base_seed=base_seed,
       dp_rank=dp_rank)
-
-  epoch, consumed = start_epoch, 0
-  if samples_seen:
-    epoch, consumed = BinnedIterator.epoch_and_offset_of(
-        datasets, batch_size_per_rank, dp_world_size, samples_seen)
-    epoch += start_epoch
-  return BertPretrainLoader(
-      datasets,
+  return build_pretrain_loader(
+      path,
       collate,
-      batch_size_per_rank,
-      seqlen_of_bin,
-      base_seed,
-      start_epoch=epoch,
-      batches_consumed=consumed,
-      micro_batch_size=micro_batch_size)
+      dp_rank=dp_rank,
+      dp_world_size=dp_world_size,
+      batch_size_per_rank=batch_size_per_rank,
+      max_seq_length=max_seq_length,
+      bin_size=bin_size,
+      sequence_length_alignment=sequence_length_alignment,
+      shuffle_buffer_size=shuffle_buffer_size,
+      shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+      base_seed=base_seed,
+      start_epoch=start_epoch,
+      samples_seen=samples_seen,
+      micro_batch_size=micro_batch_size,
+      comm=comm)

@@ -1,0 +1,123 @@
+"""CodeBERT pretraining loader: bimodal (docstring, code) batches.
+
+The reference fork adds only the CodeBERT *preprocessor*; training used
+the stock BERT loader machinery. Here the bimodal schema
+({id, doc, code, num_tokens}) gets its own collate so the segment layout
+is right even when the docstring is absent:
+
+  with doc:    [CLS] doc [SEP] code [SEP]   (types 0...0 1...1)
+  without doc: [CLS] code [SEP]             (types 0...0)
+
+matching the special-token accounting of the preprocessor (reference
+``pretrain_codebert.py:356-358``). Dynamic MLM masking reuses the BERT
+80/10/10 Philox pass.
+"""
+
+import numpy as np
+
+from .bert import BertCollate, build_pretrain_loader
+
+
+class CodebertCollate(BertCollate):
+
+  def __call__(self, rows, seq_len, epoch, step):
+    n = len(rows)
+    input_ids = np.full((n, seq_len), self._pad_id, dtype=np.int32)
+    token_type_ids = np.zeros((n, seq_len), dtype=np.int32)
+    attention_mask = np.zeros((n, seq_len), dtype=np.int32)
+    special_mask = np.ones((n, seq_len), dtype=bool)
+
+    all_tokens, spans = [], []
+    for row in rows:
+      td = row['doc'].split() if row['doc'] else []
+      tc = row['code'].split()
+      spans.append((len(td), len(tc)))
+      all_tokens.extend(td)
+      all_tokens.extend(tc)
+    all_ids = np.asarray(self._tok.convert_tokens_to_ids(all_tokens),
+                         dtype=np.int32)
+    pos = 0
+    for i, (nd, nc) in enumerate(spans):
+      ids_d = all_ids[pos:pos + nd]
+      ids_c = all_ids[pos + nd:pos + nd + nc]
+      pos += nd + nc
+      total = nd + nc + (3 if nd else 2)
+      if total > seq_len:
+        raise AssertionError(
+            f'sample of {total} tokens exceeds static seq_len {seq_len}')
+      input_ids[i, 0] = self._cls_id
+      if nd:
+        input_ids[i, 1:1 + nd] = ids_d
+        input_ids[i, 1 + nd] = self._sep_id
+        code_start = 2 + nd
+        token_type_ids[i, code_start:total] = 1
+        special_mask[i, 1:1 + nd] = False
+      else:
+        code_start = 1
+      input_ids[i, code_start:code_start + nc] = ids_c
+      input_ids[i, total - 1] = self._sep_id
+      special_mask[i, code_start:code_start + nc] = False
+      attention_mask[i, :total] = 1
+
+    input_ids, labels = self._mask_tokens(input_ids, special_mask, epoch,
+                                          step)
+    return {
+        'input_ids': input_ids,
+        'token_type_ids': token_type_ids,
+        'attention_mask': attention_mask,
+        'labels': labels,
+        'next_sentence_labels': np.zeros((n,), dtype=np.int32),
+    }
+
+
+def get_codebert_pretrain_data_loader(
+    path,
+    dp_rank=0,
+    dp_world_size=1,
+    batch_size_per_rank=16,
+    vocab_file=None,
+    tokenizer_name='microsoft/codebert-base',
+    lowercase=False,
+    mlm_probability=0.15,
+    max_seq_length=512,
+    bin_size=None,
+    sequence_length_alignment=8,
+    shuffle_buffer_size=16384,
+    shuffle_buffer_warmup_factor=16,
+    base_seed=12345,
+    start_epoch=0,
+    samples_seen=0,
+    micro_batch_size=None,
+    comm=None,
+    tokenizer=None,
+):
+  """Loader over balanced CodeBERT shards; mirrors
+  :func:`lddl_tpu.loader.get_bert_pretrain_data_loader`."""
+  if tokenizer is None:
+    from ..tokenization.wordpiece import load_bert_tokenizer
+    tokenizer = load_bert_tokenizer(
+        vocab_file=vocab_file,
+        hub_name=None if vocab_file else tokenizer_name,
+        lowercase=lowercase)
+  collate = CodebertCollate(
+      tokenizer,
+      masking='dynamic',
+      mlm_probability=mlm_probability,
+      base_seed=base_seed,
+      dp_rank=dp_rank)
+  return build_pretrain_loader(
+      path,
+      collate,
+      dp_rank=dp_rank,
+      dp_world_size=dp_world_size,
+      batch_size_per_rank=batch_size_per_rank,
+      max_seq_length=max_seq_length,
+      bin_size=bin_size,
+      sequence_length_alignment=sequence_length_alignment,
+      shuffle_buffer_size=shuffle_buffer_size,
+      shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+      base_seed=base_seed,
+      start_epoch=start_epoch,
+      samples_seen=samples_seen,
+      micro_batch_size=micro_batch_size,
+      comm=comm)

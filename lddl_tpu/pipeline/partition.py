@@ -41,14 +41,79 @@ def plan_text_partitions(paths, block_size):
   return partitions
 
 
+def read_records(text_slice, delimiter='\r\n', encoding='utf-8',
+                 chunk_size=1 << 16):
+  """Yield the records owned by a slice for an arbitrary multi-byte record
+  delimiter (the bimodal code corpus uses CRLF records whose *content*
+  contains plain newlines; reference ``lddl/dask/readers.py:130-139``).
+
+  Ownership rule matches :func:`read_lines`: a record belongs to the slice
+  in which it starts (= the byte after its predecessor's delimiter).
+  """
+  dlm = delimiter.encode(encoding)
+  nd = len(dlm)
+  with open(text_slice.path, 'rb') as f:
+    start = text_slice.start
+    if start > 0:
+      # Does a delimiter end exactly at `start`? Then a record starts here.
+      f.seek(max(0, start - nd))
+      head = f.read(min(nd, start))
+      if head != dlm:
+        # Mid-record: the true next record start is the end of the first
+        # delimiter whose END lies strictly after `start` (a delimiter may
+        # straddle the boundary, so back up nd-1 bytes before scanning).
+        scan_pos = max(0, start - (nd - 1))
+        f.seek(scan_pos)
+        buf = b''
+        found = -1
+        while found < 0:
+          chunk = f.read(chunk_size)
+          if not chunk:
+            return
+          buf += chunk
+          i = buf.find(dlm)
+          while i >= 0:
+            if scan_pos + i + nd > start:
+              found = scan_pos + i + nd
+              break
+            i = buf.find(dlm, i + 1)
+          if found < 0:
+            # Keep only a possible straddling prefix of a delimiter
+            # (nothing for a single-byte delimiter — buf[-0:] would keep
+            # the whole buffer and corrupt scan_pos).
+            keep = nd - 1
+            scan_pos += len(buf) - keep
+            buf = buf[len(buf) - keep:] if keep else b''
+        start = found
+    if start >= text_slice.end:
+      return
+    f.seek(start)
+    data = f.read(text_slice.end - start)
+    # Complete the trailing record (it started inside the slice).
+    if not data.endswith(dlm):
+      while True:
+        search_from = max(0, len(data) - (nd - 1))
+        chunk = f.read(chunk_size)
+        if not chunk:
+          break
+        data += chunk
+        i = data.find(dlm, search_from)
+        if i >= 0:
+          data = data[:i + nd]
+          break
+    for rec in data.split(dlm):
+      text = rec.decode(encoding).strip()
+      if text:
+        yield text
+
+
 def read_lines(text_slice, encoding='utf-8'):
   """Yield the complete '\\n'-separated lines owned by a slice.
 
   Ownership rule: a line belongs to the slice in which it *starts*. A slice
   whose start is mid-line skips to the next newline; a slice whose last line
-  straddles its end reads past the end to finish that line. (Documents using
-  other delimiters, e.g. the CRLF-delimited bimodal code corpus, have their
-  own reader in :mod:`lddl_tpu.preprocess.readers`.)
+  straddles its end reads past the end to finish that line. (Records with
+  multi-byte delimiters go through :func:`read_records`.)
   """
   with open(text_slice.path, 'rb') as f:
     pos = text_slice.start

@@ -32,8 +32,14 @@ def _gather_state(seed, tgt_index):
   return lrandom.get_state(f'{seed}:gather:{tgt_index}')
 
 
-def scatter_partition(lines, src_index, num_targets, spill_dir, seed):
-  """Phase A for one input partition. Returns per-target line counts."""
+def scatter_partition(lines, src_index, num_targets, spill_dir, seed,
+                      delimiter='\n'):
+  """Phase A for one input partition. Returns per-target line counts.
+
+  ``delimiter`` is the record delimiter used in the spill files — must be
+  one the records cannot contain (CRLF for code records with embedded
+  newlines).
+  """
   state = _scatter_state(seed, src_index)
   buckets = [[] for _ in range(num_targets)]
   for line in lines:
@@ -47,14 +53,14 @@ def scatter_partition(lines, src_index, num_targets, spill_dir, seed):
     tgt_dir = os.path.join(spill_dir, f'tgt{j}')
     os.makedirs(tgt_dir, exist_ok=True)
     tmp = os.path.join(tgt_dir, f'.src{src_index}.tmp')
-    with open(tmp, 'w', encoding='utf-8') as f:
+    with open(tmp, 'w', encoding='utf-8', newline='') as f:
       for line in bucket:
-        f.write(line + '\n')
+        f.write(line + delimiter)
     os.rename(tmp, os.path.join(tgt_dir, f'src{src_index}.txt'))
   return counts
 
 
-def gather_partition(tgt_index, spill_dir, seed):
+def gather_partition(tgt_index, spill_dir, seed, delimiter='\n'):
   """Phase B for one output partition: concat spills + local shuffle."""
   tgt_dir = os.path.join(spill_dir, f'tgt{tgt_index}')
   lines = []
@@ -63,17 +69,20 @@ def gather_partition(tgt_index, spill_dir, seed):
         (f for f in os.listdir(tgt_dir) if f.endswith('.txt')),
         key=lambda n: int(n[len('src'):-len('.txt')]))
     for name in names:
-      with open(os.path.join(tgt_dir, name), encoding='utf-8') as f:
-        lines.extend(l.rstrip('\n') for l in f)
+      with open(os.path.join(tgt_dir, name), encoding='utf-8',
+                newline='') as f:
+        lines.extend(r for r in f.read().split(delimiter) if r.strip())
   lrandom.shuffle(lines, rng_state=_gather_state(seed, tgt_index))
   return lines
 
 
 def _scatter_corpus_task(part_slices, idx, num_targets, spill_dir, seed,
-                         sample_ratio, sample_seed):
+                         sample_ratio, sample_seed, delimiter):
   from ..preprocess.readers import read_partition_lines
-  lines = read_partition_lines(part_slices, idx, sample_ratio, sample_seed)
-  return scatter_partition(lines, idx, num_targets, spill_dir, seed)
+  lines = read_partition_lines(part_slices, idx, sample_ratio, sample_seed,
+                               delimiter)
+  return scatter_partition(lines, idx, num_targets, spill_dir, seed,
+                           delimiter=delimiter)
 
 
 def shuffle_corpus(executor, corpus, spill_dir, seed, num_targets=None):
@@ -91,7 +100,8 @@ def shuffle_corpus(executor, corpus, spill_dir, seed, num_targets=None):
       spill_dir=spill_dir,
       seed=seed,
       sample_ratio=corpus.sample_ratio,
-      sample_seed=corpus.sample_seed)
+      sample_seed=corpus.sample_seed,
+      delimiter=corpus.delimiter)
   executor.map(task, list(corpus.partitions), gather=False)
   return num_targets
 

@@ -1,0 +1,156 @@
+"""BART pretraining preprocessor.
+
+Lighter pipeline than BERT's (reference ``lddl/dask/bart/pretrain.py``):
+sentence-split each document, then greedily aggregate sentences into
+chunks whose whitespace-token count reaches ``target_seq_length - 3``
+(reference ``_aggregate_sentences``, ``bart/pretrain.py:88-128``); no
+tokenizer, no masking, no binning. Output schema matches the reference
+(``bart/pretrain.py:136-152``): one ``sentences`` string column.
+
+The denoising noise itself (span infilling etc.) is applied at load time
+by the trainer, not here — same division of labor as the reference.
+"""
+
+import argparse
+import dataclasses
+import functools
+import time
+
+import pyarrow as pa
+
+from ..pipeline.executor import Executor
+from ..pipeline.parquet_io import write_samples_partition
+from ..pipeline.shuffle import gather_partition
+from ..tokenization import split_sentences
+from .common import run_shuffled
+from .readers import read_corpus, split_id_text
+
+
+def aggregate_sentences(sentences, target_seq_length):
+  """Greedy chunks of sentences by whitespace token count (reference
+  ``bart/pretrain.py:88-128``; the -3 accounts for [CLS]/[SEP]/[SEP])."""
+  results = []
+  target = target_seq_length - 3
+  chunk, num_tokens = '', 0
+  for sentence in sentences:
+    chunk += ' ' + sentence
+    num_tokens += len(sentence.split())
+    if num_tokens >= target:
+      results.append({'sentences': chunk, 'num_tokens': num_tokens})
+      chunk, num_tokens = '', 0
+  if num_tokens > 0:
+    results.append({'sentences': chunk, 'num_tokens': num_tokens})
+  return results
+
+
+def sequences_from_lines(lines, target_seq_length, sentence_backend='rules'):
+  out = []
+  for line in lines:
+    _, text = split_id_text(line)
+    if not text:
+      continue
+    sents = [s.strip() for s in split_sentences(text, backend=sentence_backend)]
+    out.extend(aggregate_sentences([s for s in sents if s],
+                                   target_seq_length))
+  return out
+
+
+BART_SCHEMA = pa.schema([('sentences', pa.string())])
+
+
+@dataclasses.dataclass(frozen=True)
+class BartPretrainConfig:
+  target_seq_length: int = 128
+  sentence_backend: str = 'rules'
+  seed: int = 12345
+  output_format: str = 'parquet'
+
+
+def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
+  del global_idx
+  lines = gather_partition(tgt_idx, spill_dir, cfg.seed)
+  seqs = sequences_from_lines(
+      lines, cfg.target_seq_length, sentence_backend=cfg.sentence_backend)
+  rows = [{'sentences': s['sentences']} for s in seqs]
+  out = write_samples_partition(
+      rows, BART_SCHEMA, out_dir, tgt_idx, output_format=cfg.output_format)
+  return {b: n for b, (_, n) in out.items()}
+
+
+def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
+  """Shuffle -> aggregate -> Parquet shards; returns per-partition counts."""
+  return run_shuffled(
+      corpus,
+      sink_dir,
+      functools.partial(_process_partition, out_dir=sink_dir, cfg=cfg),
+      cfg.seed,
+      executor=executor,
+      num_shuffle_partitions=num_shuffle_partitions)
+
+
+def attach_args(parser):
+  parser.add_argument('--wikipedia', type=str, default=None)
+  parser.add_argument('--books', type=str, default=None)
+  parser.add_argument('--common-crawl', type=str, default=None)
+  parser.add_argument('--open-webtext', type=str, default=None)
+  parser.add_argument('--source', type=str, default=None)
+  parser.add_argument('--sink', type=str, required=True)
+  parser.add_argument('--num-blocks', type=int, default=None)
+  parser.add_argument('--block-size', type=str, default=None)
+  parser.add_argument('--sample-ratio', type=float, default=0.9)
+  parser.add_argument('--seed', type=int, default=12345)
+  parser.add_argument('--target-seq-length', type=int, default=128)
+  parser.add_argument('--sentence-backend', type=str, default='auto',
+                      choices=['auto', 'punkt', 'rules'])
+  parser.add_argument('--output-format', type=str, default='parquet',
+                      choices=['parquet', 'txt'])
+  parser.add_argument('--num-workers', type=int, default=None)
+  parser.add_argument('--comm', type=str, default='null',
+                      choices=['null', 'file', 'jax'])
+  return parser
+
+
+def main(args=None):
+  parser = attach_args(
+      argparse.ArgumentParser(
+          description=__doc__,
+          formatter_class=argparse.ArgumentDefaultsHelpFormatter))
+  args = parser.parse_args(args)
+  from ..comm import get_backend
+  from ..core.utils import parse_str_of_num_bytes
+  dirs = [
+      d for d in (args.wikipedia, args.books, args.common_crawl,
+                  args.open_webtext, args.source) if d is not None
+  ]
+  if not dirs:
+    parser.error('need at least one source dir')
+  comm = get_backend(args.comm)
+  executor = Executor(comm=comm, num_local_workers=args.num_workers)
+  corpus = read_corpus(
+      dirs,
+      num_blocks=args.num_blocks or 4 * executor.num_local_workers *
+      comm.world_size,
+      block_size=(parse_str_of_num_bytes(args.block_size)
+                  if args.block_size else None),
+      sample_ratio=args.sample_ratio,
+      sample_seed=args.seed,
+  )
+  backend = args.sentence_backend
+  if backend == 'auto':
+    from ..tokenization.sentences import resolve_backend
+    backend = comm.broadcast_object(resolve_backend(), root=0)
+  cfg = BartPretrainConfig(
+      target_seq_length=args.target_seq_length,
+      sentence_backend=backend,
+      seed=args.seed,
+      output_format=args.output_format)
+  t0 = time.perf_counter()
+  counts = run(corpus, args.sink, cfg, executor=executor)
+  if comm.rank == 0:
+    total = sum(n for c in counts for n in c.values())
+    print(f'preprocessed {total} sequences into {len(counts)} partitions '
+          f'in {time.perf_counter() - t0:.1f}s')
+
+
+if __name__ == '__main__':
+  main()

@@ -25,8 +25,6 @@ per-partition RNG, so unlike the reference (which uses the unseeded global
 import argparse
 import dataclasses
 import functools
-import os
-import shutil
 import time
 
 import numpy as np
@@ -36,9 +34,9 @@ from ..core import attach_bool_arg, serialize_np_array
 from ..core.random import rng_from_key
 from ..pipeline.executor import Executor
 from ..pipeline.parquet_io import write_samples_partition
-from ..pipeline.shuffle import gather_partition, shuffle_corpus
+from ..pipeline.shuffle import gather_partition
 from ..tokenization import split_sentences
-from ..tokenization.wordpiece import load_bert_tokenizer
+from .common import run_shuffled
 from .readers import read_corpus, split_id_text
 
 
@@ -291,19 +289,13 @@ class BertPretrainConfig:
     return self.target_seq_length // self.bin_size
 
 
-_TOKENIZER_CACHE = {}
-
-
 def _get_tokenizer(cfg):
-  key = (cfg.vocab_file, cfg.tokenizer_name, cfg.lowercase,
-         cfg.tokenizer_backend)
-  if key not in _TOKENIZER_CACHE:
-    _TOKENIZER_CACHE[key] = load_bert_tokenizer(
-        vocab_file=cfg.vocab_file,
-        hub_name=cfg.tokenizer_name,
-        lowercase=cfg.lowercase,
-        backend=cfg.tokenizer_backend)
-  return _TOKENIZER_CACHE[key]
+  from .common import get_cached_tokenizer
+  return get_cached_tokenizer(
+      vocab_file=cfg.vocab_file,
+      hub_name=cfg.tokenizer_name,
+      lowercase=cfg.lowercase,
+      backend=cfg.tokenizer_backend)
 
 
 def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
@@ -355,23 +347,13 @@ def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
     from ..tokenization.sentences import resolve_backend
     resolved = executor.comm.broadcast_object(resolve_backend(), root=0)
     cfg = dataclasses.replace(cfg, sentence_backend=resolved)
-  os.makedirs(sink_dir, exist_ok=True)
-  spill_dir = os.path.join(sink_dir, '_shuffle_spill')
-  # Pre-clean stale spills (a rerun with fewer partitions or a crashed
-  # scatter would otherwise merge leftovers into the output), and remove
-  # the plaintext spill copy once the run has succeeded.
-  if executor.comm.rank == 0 and os.path.isdir(spill_dir):
-    shutil.rmtree(spill_dir)
-  executor.comm.barrier()
-  n = shuffle_corpus(
-      executor, corpus, spill_dir, cfg.seed,
-      num_targets=num_shuffle_partitions)
-  task = functools.partial(
-      _process_partition, spill_dir=spill_dir, out_dir=sink_dir, cfg=cfg)
-  counts = executor.map(task, list(range(n)))
-  if executor.comm.rank == 0:
-    shutil.rmtree(spill_dir, ignore_errors=True)
-  return counts
+  return run_shuffled(
+      corpus,
+      sink_dir,
+      functools.partial(_process_partition, out_dir=sink_dir, cfg=cfg),
+      cfg.seed,
+      executor=executor,
+      num_shuffle_partitions=num_shuffle_partitions)
 
 
 def attach_args(parser):

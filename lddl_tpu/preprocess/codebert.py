@@ -1,0 +1,297 @@
+"""CodeBERT pretraining preprocessor (bimodal docstring/code pairs).
+
+Capability parity: the fork's ``lddl/dask/bert/pretrain_codebert.py``.
+Input: CRLF-delimited ``id<CODESPLIT>docstring<CODESPLIT>code`` records
+(see :func:`lddl_tpu.preprocess.readers.read_code`). Per record
+(reference ``pretrain_codebert.py:343-442``):
+
+  - docstring and code are each split into line "sentences" and tokenized;
+  - a *doc segment* is built from leading docstring lines, capped at
+    ``64 if max_seq_length >= 512 else 32`` tokens (with 10% probability
+    just the first docstring line — the short-seq analogue);
+  - code lines slide through chunks: a chunk flushes when it would exceed
+    ``max_seq_length - doc_len - specials``, emitting one instance, and
+    the overflowing last line carries over into the next chunk so long
+    functions yield multiple overlapping pairs;
+  - chunks shorter than 16 code tokens are dropped (except the first);
+  - output schema {id, doc, code, num_tokens}, optionally binned the same
+    way as BERT shards. MLM masks are applied dynamically at load time.
+
+Unlike the reference (unseeded global ``random`` in Dask workers), every
+draw threads a per-partition RNG: reruns are deterministic.
+"""
+
+import argparse
+import dataclasses
+import functools
+import time
+
+import pyarrow as pa
+
+from ..core import attach_bool_arg
+from ..core.random import rng_from_key
+from ..pipeline.executor import Executor
+from ..pipeline.parquet_io import write_samples_partition
+from ..pipeline.shuffle import gather_partition
+from .common import run_shuffled
+from .readers import read_code, split_id_code_docstring
+
+MIN_CODE_TOKENS = 16
+
+
+@dataclasses.dataclass(frozen=True)
+class CodeDocument:
+  doc_id: str
+  doc_segments: tuple  # tuple of token tuples (docstring lines)
+  code_segments: tuple  # tuple of token tuples (code lines)
+
+
+def truncate_seq(tokens, max_num_tokens, rng):
+  """Random front/back pops until the sequence fits (reference
+  ``pretrain_codebert.py:236-247``)."""
+  while len(tokens) > max_num_tokens:
+    if rng.random() < 0.5:
+      del tokens[0]
+    else:
+      tokens.pop()
+
+
+def documents_from_records(records, tokenizer, max_length=512):
+  """Parse + batch-tokenize bimodal records into CodeDocuments."""
+  parsed = []
+  all_strs = []
+  for rec in records:
+    split = split_id_code_docstring(rec)
+    if split is None:
+      continue
+    doc_id, docstring, code = split
+    doc_lines = [s.strip() for s in docstring.split('\n')]
+    doc_lines = [s for s in doc_lines if s]
+    code_lines = [s.strip() for s in code.split('\n')]
+    code_lines = [s for s in code_lines if s]
+    parsed.append((doc_id, len(doc_lines), len(code_lines)))
+    all_strs.extend(doc_lines)
+    all_strs.extend(code_lines)
+  all_tokens = tokenizer.batch_tokenize(all_strs, max_length=max_length)
+  documents, pos = [], 0
+  for doc_id, n_doc, n_code in parsed:
+    doc_toks = tuple(
+        tuple(t) for t in all_tokens[pos:pos + n_doc] if t)
+    pos += n_doc
+    code_toks = tuple(
+        tuple(t) for t in all_tokens[pos:pos + n_code] if t)
+    pos += n_code
+    if code_toks:
+      documents.append(CodeDocument(doc_id, doc_toks, code_toks))
+  return documents
+
+
+def build_doc_segment(document, max_doc_seq_length, short_seq_prob, rng):
+  """Leading docstring lines capped at max_doc_seq_length tokens; with
+  probability short_seq_prob just the first line (reference
+  ``pretrain_codebert.py:369-398``)."""
+  segs = document.doc_segments
+  if not segs:
+    return []
+  if rng.random() < short_seq_prob:
+    doc_tokens = list(segs[0])
+  else:
+    doc_tokens = []
+    chunk, length = [], 0
+    for i, seg in enumerate(segs):
+      chunk.append(seg)
+      length += len(seg)
+      if i == len(segs) - 1 or length > max_doc_seq_length:
+        end = len(chunk) - 1 if (length > max_doc_seq_length and
+                                 len(chunk) > 1) else len(chunk)
+        for s in chunk[:end]:
+          doc_tokens.extend(s)
+        break
+  truncate_seq(doc_tokens, max_doc_seq_length, rng)
+  return doc_tokens
+
+
+def create_pairs_from_document(document, rng, max_seq_length=512,
+                               short_seq_prob=0.1):
+  """Sliding code-chunk pairing with carry-over (reference
+  ``pretrain_codebert.py:343-442``)."""
+  special = 3 if document.doc_segments else 2
+  max_num_tokens = max_seq_length - special
+  max_doc_seq_length = 64 if max_seq_length >= 512 else 32
+  doc_tokens = build_doc_segment(document, max_doc_seq_length,
+                                 short_seq_prob, rng)
+  doc_len = len(doc_tokens)
+  target = max_num_tokens
+
+  instances = []
+  chunk, length = [], doc_len
+  for i, seg in enumerate(document.code_segments):
+    chunk.append(seg)
+    length += len(seg)
+    if i == len(document.code_segments) - 1 or length > target:
+      if chunk:
+        carry = (length > max_num_tokens and len(chunk) > 1)
+        code_tokens = [t for s in chunk for t in s]
+        truncate_seq(code_tokens, max_num_tokens - doc_len, rng)
+        if code_tokens and (not instances or
+                            len(code_tokens) >= MIN_CODE_TOKENS):
+          instances.append({
+              'id': document.doc_id,
+              'doc': ' '.join(doc_tokens),
+              'code': ' '.join(code_tokens),
+              'num_tokens': doc_len + len(code_tokens) + special,
+          })
+        chunk = [chunk[-1]] if carry else []
+        length = sum(len(s) for s in chunk) + doc_len
+  return instances
+
+
+CODEBERT_SCHEMA = pa.schema([
+    ('id', pa.string()),
+    ('doc', pa.string()),
+    ('code', pa.string()),
+    ('num_tokens', pa.uint16()),
+])
+
+
+@dataclasses.dataclass(frozen=True)
+class CodebertPretrainConfig:
+  vocab_file: str = None
+  tokenizer_name: str = 'microsoft/codebert-base'
+  tokenizer_backend: str = 'hf'
+  lowercase: bool = False  # code is case-sensitive; codebert-base is cased
+  target_seq_length: int = 512
+  short_seq_prob: float = 0.1
+  duplicate_factor: int = 1
+  bin_size: int = None
+  seed: int = 12345
+  output_format: str = 'parquet'
+
+  @property
+  def nbins(self):
+    if self.bin_size is None:
+      return None
+    if self.target_seq_length % self.bin_size != 0:
+      raise ValueError('bin_size must divide target_seq_length')
+    return self.target_seq_length // self.bin_size
+
+
+def _get_tokenizer(cfg):
+  from .common import get_cached_tokenizer
+  return get_cached_tokenizer(
+      vocab_file=cfg.vocab_file,
+      hub_name=None if cfg.vocab_file else cfg.tokenizer_name,
+      lowercase=cfg.lowercase,
+      backend=cfg.tokenizer_backend)
+
+
+def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg,
+                       delimiter='\r\n'):
+  del global_idx
+  tokenizer = _get_tokenizer(cfg)
+  records = gather_partition(tgt_idx, spill_dir, cfg.seed,
+                             delimiter=delimiter)
+  documents = documents_from_records(records, tokenizer,
+                                     max_length=cfg.target_seq_length)
+  rng = rng_from_key(cfg.seed, 'code-pairs', tgt_idx)
+  instances = []
+  for _ in range(cfg.duplicate_factor):
+    for document in documents:
+      instances.extend(
+          create_pairs_from_document(
+              document,
+              rng,
+              max_seq_length=cfg.target_seq_length,
+              short_seq_prob=cfg.short_seq_prob))
+  out = write_samples_partition(
+      instances,
+      CODEBERT_SCHEMA,
+      out_dir,
+      tgt_idx,
+      bin_size=cfg.bin_size,
+      nbins=cfg.nbins,
+      output_format=cfg.output_format,
+  )
+  return {b: n for b, (_, n) in out.items()}
+
+
+def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
+  # The gather delimiter must match what scatter wrote: the corpus's own.
+  return run_shuffled(
+      corpus,
+      sink_dir,
+      functools.partial(_process_partition, out_dir=sink_dir, cfg=cfg,
+                        delimiter=corpus.delimiter),
+      cfg.seed,
+      executor=executor,
+      num_shuffle_partitions=num_shuffle_partitions)
+
+
+def attach_args(parser):
+  parser.add_argument('--source', type=str, required=True,
+                      help='dir of CRLF-delimited <CODESPLIT> shards')
+  parser.add_argument('--sink', type=str, required=True)
+  parser.add_argument('--num-blocks', type=int, default=None)
+  parser.add_argument('--block-size', type=str, default=None)
+  parser.add_argument('--sample-ratio', type=float, default=1.0)
+  parser.add_argument('--seed', type=int, default=12345)
+  parser.add_argument('--vocab-file', type=str, default=None)
+  parser.add_argument('--tokenizer', type=str,
+                      default='microsoft/codebert-base')
+  parser.add_argument('--tokenizer-backend', type=str, default='hf',
+                      choices=['hf', 'native'])
+  attach_bool_arg(parser, 'lowercase', default=False,
+                  help_str='lowercase code (codebert-base is cased)')
+  parser.add_argument('--target-seq-length', type=int, default=512)
+  parser.add_argument('--short-seq-prob', type=float, default=0.1)
+  parser.add_argument('--duplicate-factor', type=int, default=1)
+  parser.add_argument('--bin-size', type=int, default=None)
+  parser.add_argument('--output-format', type=str, default='parquet',
+                      choices=['parquet', 'txt'])
+  parser.add_argument('--num-workers', type=int, default=None)
+  parser.add_argument('--comm', type=str, default='null',
+                      choices=['null', 'file', 'jax'])
+  attach_bool_arg(parser, 'verbose', default=False)
+  return parser
+
+
+def main(args=None):
+  parser = attach_args(
+      argparse.ArgumentParser(
+          description=__doc__,
+          formatter_class=argparse.ArgumentDefaultsHelpFormatter))
+  args = parser.parse_args(args)
+  from ..comm import get_backend
+  from ..core.utils import parse_str_of_num_bytes
+  comm = get_backend(args.comm)
+  executor = Executor(comm=comm, num_local_workers=args.num_workers)
+  corpus = read_code(
+      args.source,
+      num_blocks=args.num_blocks or 4 * executor.num_local_workers *
+      comm.world_size,
+      block_size=(parse_str_of_num_bytes(args.block_size)
+                  if args.block_size else None),
+      sample_ratio=args.sample_ratio,
+      sample_seed=args.seed,
+  )
+  cfg = CodebertPretrainConfig(
+      vocab_file=args.vocab_file,
+      tokenizer_name=args.tokenizer,
+      tokenizer_backend=args.tokenizer_backend,
+      lowercase=args.lowercase,
+      target_seq_length=args.target_seq_length,
+      short_seq_prob=args.short_seq_prob,
+      duplicate_factor=args.duplicate_factor,
+      bin_size=args.bin_size,
+      seed=args.seed,
+      output_format=args.output_format)
+  t0 = time.perf_counter()
+  counts = run(corpus, args.sink, cfg, executor=executor)
+  if comm.rank == 0:
+    total = sum(n for c in counts for n in c.values())
+    print(f'preprocessed {total} pairs into {len(counts)} partitions '
+          f'in {time.perf_counter() - t0:.1f}s')
+
+
+if __name__ == '__main__':
+  main()

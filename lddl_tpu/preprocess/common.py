@@ -1,0 +1,50 @@
+"""Shared preprocess orchestration: the shuffle-spill run skeleton and the
+per-process tokenizer cache used by every preprocessor frontend."""
+
+import functools
+import os
+import shutil
+
+from ..pipeline.executor import Executor
+from ..pipeline.shuffle import shuffle_corpus
+
+_TOKENIZER_CACHE = {}
+
+
+def get_cached_tokenizer(vocab_file=None, hub_name=None, lowercase=True,
+                         backend='hf'):
+  """One tokenizer per (vocab, name, case, backend) per worker process."""
+  key = (vocab_file, hub_name, lowercase, backend)
+  if key not in _TOKENIZER_CACHE:
+    from ..tokenization.wordpiece import load_bert_tokenizer
+    _TOKENIZER_CACHE[key] = load_bert_tokenizer(
+        vocab_file=vocab_file,
+        hub_name=hub_name,
+        lowercase=lowercase,
+        backend=backend)
+  return _TOKENIZER_CACHE[key]
+
+
+def run_shuffled(corpus, sink_dir, process_partition, seed, executor=None,
+                 num_shuffle_partitions=None):
+  """Global shuffle -> ``process_partition(tgt_idx, global_idx)`` fan-out.
+
+  ``process_partition`` must be a picklable callable taking
+  ``(tgt_idx, global_idx, spill_dir)`` (use ``functools.partial`` to bind
+  config). Pre-cleans stale spills from a previous crashed/re-partitioned
+  run, removes the plaintext spill copy on success, and returns the
+  task-ordered result list.
+  """
+  executor = executor or Executor()
+  os.makedirs(sink_dir, exist_ok=True)
+  spill_dir = os.path.join(sink_dir, '_shuffle_spill')
+  if executor.comm.rank == 0 and os.path.isdir(spill_dir):
+    shutil.rmtree(spill_dir)
+  executor.comm.barrier()
+  n = shuffle_corpus(
+      executor, corpus, spill_dir, seed, num_targets=num_shuffle_partitions)
+  task = functools.partial(process_partition, spill_dir=spill_dir)
+  results = executor.map(task, list(range(n)))
+  if executor.comm.rank == 0:
+    shutil.rmtree(spill_dir, ignore_errors=True)
+  return results

@@ -19,7 +19,10 @@ from ..pipeline.partition import (
     estimate_block_size,
     plan_text_partitions,
     read_lines,
+    read_records,
 )
+
+CODE_SPLIT = '<CODESPLIT>'
 
 
 def split_id_text(raw_text):
@@ -30,6 +33,15 @@ def split_id_text(raw_text):
   return parts[0], parts[1]
 
 
+def split_id_code_docstring(raw_text):
+  """Split a bimodal code record into (id, docstring, code) on the
+  ``<CODESPLIT>`` separator (reference ``lddl/dask/readers.py:150-151``)."""
+  parts = raw_text.split(CODE_SPLIT)
+  if len(parts) != 3:
+    return None
+  return tuple(parts)
+
+
 @dataclasses.dataclass(frozen=True)
 class Corpus:
   """A partitioned view of one or more source directories."""
@@ -37,6 +49,7 @@ class Corpus:
   partitions: tuple  # tuple of tuples of TextSlice
   sample_ratio: float = 1.0
   sample_seed: int = 12345
+  delimiter: str = '\n'  # record delimiter ('\r\n' for the code corpus)
 
   @property
   def num_partitions(self):
@@ -45,10 +58,11 @@ class Corpus:
   def read_partition(self, idx):
     """Yield the (possibly subsampled) raw document lines of partition idx."""
     return read_partition_lines(self.partitions[idx], idx, self.sample_ratio,
-                                self.sample_seed)
+                                self.sample_seed, self.delimiter)
 
 
-def read_partition_lines(part_slices, idx, sample_ratio, sample_seed):
+def read_partition_lines(part_slices, idx, sample_ratio, sample_seed,
+                         delimiter='\n'):
   """Yield one partition's (possibly subsampled) document lines.
 
   Module-level so distributed tasks can carry just their own slices plus
@@ -56,13 +70,15 @@ def read_partition_lines(part_slices, idx, sample_ratio, sample_seed):
   """
   rng = rng_from_key(sample_seed, 'corpus-sample', idx)
   for s in part_slices:
-    for line in read_lines(s):
+    records = (read_lines(s) if delimiter == '\n' else
+               read_records(s, delimiter=delimiter))
+    for line in records:
       if sample_ratio >= 1.0 or rng.random() < sample_ratio:
         yield line
 
 
 def read_corpus(dirs, num_blocks=None, block_size=None, sample_ratio=1.0,
-                sample_seed=12345):
+                sample_seed=12345, delimiter='\n'):
   """Plan a corpus from source directories of one-doc-per-line txt shards.
 
   Exactly one of num_blocks/block_size controls partition granularity
@@ -85,6 +101,7 @@ def read_corpus(dirs, num_blocks=None, block_size=None, sample_ratio=1.0,
       partitions=tuple((s,) for s in slices),
       sample_ratio=sample_ratio,
       sample_seed=sample_seed,
+      delimiter=delimiter,
   )
 
 
@@ -101,4 +118,12 @@ def read_common_crawl(path, **kwargs):
 
 
 def read_open_webtext(path, **kwargs):
+  return read_corpus(path, **kwargs)
+
+
+def read_code(path, **kwargs):
+  """Bimodal code corpus: CRLF-delimited ``id<CODESPLIT>doc<CODESPLIT>code``
+  records whose content contains plain newlines (reference
+  ``lddl/dask/readers.py:130-139``)."""
+  kwargs.setdefault('delimiter', '\r\n')
   return read_corpus(path, **kwargs)

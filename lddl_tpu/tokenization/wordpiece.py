@@ -52,6 +52,14 @@ class BertWordPiece:
     return self._hf.mask_token
 
   @property
+  def cls_token_id(self):
+    return self._hf.cls_token_id
+
+  @property
+  def sep_token_id(self):
+    return self._hf.sep_token_id
+
+  @property
   def mask_token_id(self):
     return self._hf.mask_token_id
 

@@ -1,0 +1,98 @@
+import os
+import threading
+import types
+
+from lddl_tpu import cli
+from lddl_tpu.download.common_crawl import ArticleSink, read_spools
+from lddl_tpu.download.utils import shard_documents
+from lddl_tpu.download.wikipedia import parse_extracted_shard
+
+
+class TestShardDocuments:
+
+  def test_round_robin_and_flatten(self, tmp_path):
+    docs = [(f'd{i}', f'line one\nline  two {i}') for i in range(7)]
+    counts = shard_documents(iter(docs), str(tmp_path / 'out'), 3)
+    assert counts == [3, 2, 2]
+    text0 = (tmp_path / 'out' / '0.txt').read_text()
+    assert text0.splitlines()[0] == 'd0 line one line two 0'
+
+  def test_drops_empty(self, tmp_path):
+    docs = [('a', 'x'), ('b', '   \n '), ('c', 'y')]
+    counts = shard_documents(iter(docs), str(tmp_path / 'out'), 2)
+    assert sum(counts) == 2
+
+
+class TestWikipediaParse:
+
+  def test_parse_extracted(self, tmp_path):
+    p = tmp_path / 'wiki_00'
+    p.write_text(
+        '<doc id="12" url="u" title="Anarchism">\n'
+        'Anarchism\n'
+        '\n'
+        'Anarchism is a philosophy.\n'
+        'It questions authority.\n'
+        '</doc>\n'
+        '<doc id="25" url="u" title="Autism">\n'
+        'Autism\n'
+        'Autism is a condition.\n'
+        '</doc>\n')
+    docs = list(parse_extracted_shard(str(p)))
+    assert docs == [
+        ('wiki-12', 'Anarchism is a philosophy. It questions authority.'),
+        ('wiki-25', 'Autism is a condition.'),
+    ]
+
+
+class TestArticleSink:
+
+  def test_multithreaded_flush(self, tmp_path):
+    sink = ArticleSink(str(tmp_path / 'spool'), articles_per_flush=4)
+
+    def worker(k):
+      for i in range(5):
+        sink(types.SimpleNamespace(
+            maintext=f'text {k}-{i}', title=f'T{k}'))
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(3)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join()
+    sink.flush()  # must flush every thread's tail, not just the caller's
+    docs = list(read_spools(str(tmp_path / 'spool')))
+    assert len(docs) == 15
+    ids = {d[0] for d in docs}
+    assert len(ids) == 15  # unique ids
+
+
+class TestCli:
+
+  def test_usage(self, capsys, monkeypatch):
+    monkeypatch.setattr('sys.argv', ['cli'])
+    assert cli.main() == 2
+    assert 'preprocess_bert_pretrain' in capsys.readouterr().out
+
+  def test_end_to_end_pipeline(self, tmp_path, tiny_vocab, tmp_corpus):
+    sink = str(tmp_path / 'sink')
+    balanced = str(tmp_path / 'balanced')
+    cli.preprocess_bert_pretrain([
+        '--source', tmp_corpus, '--sink', sink, '--vocab-file', tiny_vocab,
+        '--num-blocks', '2', '--num-workers', '1', '--bin-size', '64',
+        '--sample-ratio', '1.0', '--sentence-backend', 'rules',
+    ])
+    assert any(f.endswith('.parquet_0') for f in os.listdir(sink))
+    cli.balance_shards(
+        ['--indir', sink, '--outdir', balanced, '--num-shards', '2'])
+    assert os.path.isfile(os.path.join(balanced, '.num_samples.json'))
+    cli.generate_num_samples_cache(['--path', balanced])
+
+  def test_bart_cli(self, tmp_path, tmp_corpus):
+    sink = str(tmp_path / 'bart_sink')
+    cli.preprocess_bart_pretrain([
+        '--source', tmp_corpus, '--sink', sink, '--num-blocks', '2',
+        '--num-workers', '1', '--sentence-backend', 'rules',
+        '--sample-ratio', '1.0',
+    ])
+    assert any(f.endswith('.parquet') for f in os.listdir(sink))

@@ -1,0 +1,188 @@
+import os
+import random
+
+import pyarrow.parquet as pq
+import pytest
+
+from lddl_tpu.balance import balance_directory
+from lddl_tpu.comm import NullBackend
+from lddl_tpu.pipeline.executor import Executor
+from lddl_tpu.pipeline.partition import TextSlice, read_records
+from lddl_tpu.preprocess import bart, codebert
+from lddl_tpu.preprocess.readers import read_code, read_corpus
+
+from conftest import WORDS
+
+
+class TestReadRecords:
+
+  def _write(self, tmp_path, records):
+    p = tmp_path / 'data.txt'
+    with open(p, 'w', newline='') as f:
+      for r in records:
+        f.write(r + '\r\n')
+    return str(p), os.path.getsize(p)
+
+  def test_whole_file(self, tmp_path):
+    recs = ['a\nb\nc', 'dd\nee', 'fff']
+    path, size = self._write(tmp_path, recs)
+    got = list(read_records(TextSlice(path, 0, size)))
+    assert got == recs
+
+  @pytest.mark.parametrize('block', [1, 2, 3, 5, 7, 11, 64])
+  def test_every_split_covers_exactly_once(self, tmp_path, block):
+    recs = ['a\nb\nc', 'dd\nee', 'f', 'gg\rhh', 'iii\n']
+    path, size = self._write(tmp_path, recs)
+    got = []
+    for start in range(0, size, block):
+      got.extend(
+          read_records(TextSlice(path, start, min(start + block, size))))
+    assert got == [r.strip() for r in recs]
+
+  @pytest.mark.parametrize('chunk_size', [1, 2, 64])
+  def test_single_byte_delimiter(self, tmp_path, chunk_size):
+    p = tmp_path / 'tab.txt'
+    recs = ['aaaa', 'b', 'cc dd', 'eeee']
+    p.write_text('\t'.join(recs) + '\t')
+    size = os.path.getsize(p)
+    for block in (2, 3, 64):
+      got = []
+      for start in range(0, size, block):
+        got.extend(
+            read_records(
+                TextSlice(str(p), start, min(start + block, size)),
+                delimiter='\t',
+                chunk_size=chunk_size))
+      assert got == recs
+
+
+def _gen_text_source(tmp_path, n_docs=30):
+  src = tmp_path / 'src'
+  src.mkdir()
+  r = random.Random(3)
+  with open(src / '0.txt', 'w') as f:
+    for d in range(n_docs):
+      sents = [
+          (' '.join(r.choice(WORDS) for _ in range(r.randrange(5, 14))) +
+           '.').capitalize() for _ in range(r.randrange(4, 10))
+      ]
+      f.write(f'doc-{d} ' + ' '.join(sents) + '\n')
+  return str(src)
+
+
+class TestBart:
+
+  def test_aggregate_sentences(self):
+    sents = ['a b c', 'd e', 'f g h i', 'j']
+    out = bart.aggregate_sentences(sents, target_seq_length=8)
+    # target=5: chunk1 = 'a b c'+'d e' (5 tokens) flushes; then 'f g h i'
+    # (4<5) + 'j' = 5 flushes.
+    assert len(out) == 2
+    assert out[0]['sentences'] == ' a b c d e'
+    assert out[0]['num_tokens'] == 5
+    assert out[1]['num_tokens'] == 5
+
+  def test_end_to_end(self, tmp_path):
+    src = _gen_text_source(tmp_path)
+    sink = str(tmp_path / 'sink')
+    cfg = bart.BartPretrainConfig(target_seq_length=32, seed=7)
+    corpus = read_corpus(src, num_blocks=3)
+    counts = bart.run(corpus, sink, cfg, executor=Executor(num_local_workers=1))
+    total = sum(n for c in counts for n in c.values())
+    assert total > 0
+    files = [f for f in os.listdir(sink) if f.endswith('.parquet')]
+    t = pq.read_table(os.path.join(sink, files[0]))
+    assert t.column_names == ['sentences']
+    # deterministic rerun
+    sink2 = str(tmp_path / 'sink2')
+    bart.run(corpus, sink2, cfg, executor=Executor(num_local_workers=1))
+    for f in files:
+      assert pq.read_table(os.path.join(sink, f)).equals(
+          pq.read_table(os.path.join(sink2, f)))
+
+
+def _gen_code_source(tmp_path, n=24):
+  src = tmp_path / 'code_src'
+  src.mkdir()
+  r = random.Random(9)
+  with open(src / '0.txt', 'w', newline='') as f:
+    for i in range(n):
+      doc_lines = [
+          ' '.join(r.choice(WORDS) for _ in range(r.randrange(3, 8)))
+          for _ in range(r.randrange(0, 3))
+      ]
+      code_lines = [
+          ' '.join(r.choice(WORDS) for _ in range(r.randrange(4, 10)))
+          for _ in range(r.randrange(3, 12))
+      ]
+      rec = f'fn-{i}<CODESPLIT>' + '\n'.join(doc_lines) + '<CODESPLIT>' + \
+          '\n'.join(code_lines)
+      f.write(rec + '\r\n')
+  return str(src)
+
+
+class TestCodebert:
+
+  def test_pairs_from_document(self):
+    rng = random.Random(0)
+    doc = codebert.CodeDocument(
+        'f1',
+        doc_segments=(('alpha', 'bravo'),),
+        code_segments=tuple(
+            tuple(f'tok{i}_{j}' for j in range(10)) for i in range(8)))
+    pairs = codebert.create_pairs_from_document(
+        doc, rng, max_seq_length=64, short_seq_prob=0.0)
+    assert len(pairs) >= 2  # 80 code tokens over <=61-token windows
+    for p in pairs:
+      assert p['num_tokens'] <= 64
+      assert p['doc'] == 'alpha bravo'
+      assert p['num_tokens'] == len(p['doc'].split()) + len(
+          p['code'].split()) + 3
+    # Carry-over: the overflowing last code line appears in both pairs
+    # (modulo up to one randomly-truncated token per side).
+    overlap = set(pairs[0]['code'].split()) & set(pairs[1]['code'].split())
+    assert len(overlap) >= 8
+
+  def test_no_docstring_special_accounting(self):
+    rng = random.Random(0)
+    doc = codebert.CodeDocument(
+        'f2', doc_segments=(),
+        code_segments=(('a', 'b', 'c'),))
+    pairs = codebert.create_pairs_from_document(doc, rng, max_seq_length=32)
+    assert len(pairs) == 1
+    assert pairs[0]['doc'] == ''
+    assert pairs[0]['num_tokens'] == 3 + 2
+
+  def test_end_to_end_with_loader(self, tmp_path, tiny_vocab):
+    src = _gen_code_source(tmp_path)
+    sink = str(tmp_path / 'sink')
+    cfg = codebert.CodebertPretrainConfig(
+        vocab_file=tiny_vocab,
+        target_seq_length=64,
+        bin_size=16,
+        seed=11)
+    corpus = read_code(src, num_blocks=3)
+    counts = codebert.run(corpus, sink, cfg,
+                          executor=Executor(num_local_workers=1))
+    total = sum(n for c in counts for n in c.values())
+    assert total > 0
+    balanced = str(tmp_path / 'balanced')
+    balance_directory(sink, balanced, 2, NullBackend())
+
+    from lddl_tpu.loader import get_codebert_pretrain_data_loader
+    loader = get_codebert_pretrain_data_loader(
+        balanced,
+        vocab_file=tiny_vocab,
+        batch_size_per_rank=2,
+        bin_size=16,
+        max_seq_length=64,
+        shuffle_buffer_size=8)
+    import numpy as np
+    n = 0
+    for batch in loader:
+      n += 1
+      assert batch['input_ids'].shape[1] in (16, 24, 32, 40, 48, 56, 64)
+      # type-1 region only when a docstring-separated code segment exists
+      assert ((batch['labels'] != -100) <=
+              (batch['attention_mask'] == 1)).all()
+    assert n == len(loader) > 0
