@@ -314,8 +314,11 @@ def get_bert_pretrain_data_loader(
   """
   if tokenizer is None:
     from ..tokenization.wordpiece import load_bert_tokenizer
+    # hf backend: loaders only convert ids/decode — the native encoder (and
+    # its on-demand g++ build) is a preprocessing-side tool.
     tokenizer = load_bert_tokenizer(
-        vocab_file=vocab_file, hub_name=tokenizer_name, lowercase=lowercase)
+        vocab_file=vocab_file, hub_name=tokenizer_name, lowercase=lowercase,
+        backend='hf')
   collate = BertCollate(
       tokenizer,
       masking=masking,

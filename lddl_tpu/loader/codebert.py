@@ -98,7 +98,8 @@ def get_codebert_pretrain_data_loader(
     tokenizer = load_bert_tokenizer(
         vocab_file=vocab_file,
         hub_name=None if vocab_file else tokenizer_name,
-        lowercase=lowercase)
+        lowercase=lowercase,
+        backend='hf')
   collate = CodebertCollate(
       tokenizer,
       masking='dynamic',

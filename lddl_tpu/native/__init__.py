@@ -1,0 +1,15 @@
+"""Native (C++) host kernels for the TPU framework.
+
+The reference is pure Python and gets its native speed from external
+dependencies (HF tokenizers, pyarrow; SURVEY.md §2). This package owns the
+in-repo native layer: a C++ WordPiece encoder + sentence segmenter +
+string-column builder compiled to a shared library and driven through
+ctypes (no pybind11 in the image).
+
+The library is compiled on demand from ``src/wordpiece.cpp`` with g++ and
+cached next to the source; ``python -m lddl_tpu.native.build`` prebuilds it
+explicitly (setup.py runs this for wheels).
+"""
+
+from .build import load_library, build_library  # noqa: F401
+from .wordpiece import NativeWordPiece  # noqa: F401

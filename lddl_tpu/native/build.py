@@ -1,0 +1,96 @@
+"""Build/load the native shared library.
+
+Compiles ``src/wordpiece.cpp`` with g++ into ``_lddl_native.<abi>.so`` next
+to this file. A content hash of the source is embedded in the filename so
+editing the C++ transparently rebuilds; a file lock serializes concurrent
+builders (many worker processes may race on first use).
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+_SRC = os.path.join(os.path.dirname(__file__), 'src', 'wordpiece.cpp')
+_LIB_CACHE = {}
+
+
+def _lib_path():
+  with open(_SRC, 'rb') as f:
+    digest = hashlib.sha256(f.read()).hexdigest()[:12]
+  return os.path.join(os.path.dirname(__file__), f'_lddl_native.{digest}.so')
+
+
+def build_library(verbose=False):
+  """Compile if needed; returns the .so path."""
+  path = _lib_path()
+  if os.path.exists(path):
+    return path
+  lock = path + '.lock'
+  fd = os.open(lock, os.O_CREAT | os.O_RDWR)
+  try:
+    import fcntl
+    fcntl.flock(fd, fcntl.LOCK_EX)
+    if os.path.exists(path):
+      return path
+    with tempfile.TemporaryDirectory(dir=os.path.dirname(path)) as tmp:
+      tmp_so = os.path.join(tmp, 'out.so')
+      cmd = [
+          'g++', '-O3', '-march=native', '-shared', '-fPIC', '-std=c++17',
+          '-pthread', '-o', tmp_so, _SRC
+      ]
+      if verbose:
+        print('building native library:', ' '.join(cmd))
+      subprocess.run(cmd, check=True, capture_output=not verbose)
+      os.replace(tmp_so, path)  # atomic publish
+    return path
+  finally:
+    os.close(fd)
+    try:
+      os.unlink(lock)
+    except OSError:
+      pass
+
+
+def load_library():
+  """Build (if needed) and dlopen the native library; cached per process."""
+  path = build_library()
+  lib = _LIB_CACHE.get(path)
+  if lib is not None:
+    return lib
+  lib = ctypes.CDLL(path)
+  c = ctypes
+  lib.lddl_wp_create.restype = c.c_void_p
+  lib.lddl_wp_create.argtypes = [
+      c.c_char_p, c.POINTER(c.c_int64), c.c_int32, c.c_int32, c.c_int32,
+      c.c_int32
+  ]
+  lib.lddl_wp_destroy.argtypes = [c.c_void_p]
+  lib.lddl_wp_encode_batch.restype = c.c_int64
+  lib.lddl_wp_encode_batch.argtypes = [
+      c.c_void_p, c.c_char_p, c.POINTER(c.c_int64), c.c_int64, c.c_int32,
+      c.POINTER(c.c_int32), c.c_int64, c.POINTER(c.c_int64), c.c_int32
+  ]
+  lib.lddl_split_sentences.restype = c.c_int64
+  lib.lddl_split_sentences.argtypes = [
+      c.c_char_p, c.c_int64, c.POINTER(c.c_int64), c.c_int64
+  ]
+  lib.lddl_encode_docs.restype = c.c_int64
+  lib.lddl_encode_docs.argtypes = [
+      c.c_void_p, c.c_char_p, c.POINTER(c.c_int64), c.c_int64, c.c_int32,
+      c.POINTER(c.c_int32), c.c_int64, c.POINTER(c.c_int64), c.c_int64,
+      c.POINTER(c.c_int64), c.c_int32
+  ]
+  lib.lddl_decode_join.restype = c.c_int64
+  lib.lddl_decode_join.argtypes = [
+      c.c_void_p, c.POINTER(c.c_int32), c.POINTER(c.c_int64), c.c_int64,
+      c.c_char_p, c.c_int64, c.POINTER(c.c_int32)
+  ]
+  lib.lddl_native_abi_version.restype = c.c_int64
+  _LIB_CACHE[path] = lib
+  return lib
+
+
+if __name__ == '__main__':
+  print(build_library(verbose=True))

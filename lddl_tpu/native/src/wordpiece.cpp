@@ -1,0 +1,604 @@
+// lddl_tpu native host kernels: BERT text normalization, WordPiece
+// longest-match encoding, rule-based sentence segmentation, and token-id ->
+// space-joined-string decoding (emitting Arrow string-column buffers).
+//
+// This is the TPU-framework replacement for the per-sentence Python
+// tokenize loop of the reference (lddl/dask/bert/pretrain.py:77-97): the
+// whole partition is one C call, internally multithreaded, GIL-free.
+// Exposed through a plain C ABI consumed with ctypes
+// (lddl_tpu/native/wordpiece.py) -- no pybind11 dependency.
+//
+// Normalization parity: matches HuggingFace's BertNormalizer for ASCII,
+// Latin-1/Latin-Extended-A accents, Greek/Cyrillic lowercase, combining
+// marks, and CJK spacing. Exotic scripts outside those ranges pass through
+// unchanged (divergence documented in lddl_tpu/native/wordpiece.py).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <string>
+#include <string_view>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- unicode
+
+// Decode one UTF-8 codepoint starting at s[i]; advances i. Invalid bytes
+// decode as 0xFFFD and advance by one.
+inline uint32_t decode_utf8(const char* s, int64_t len, int64_t& i) {
+  unsigned char c = s[i];
+  if (c < 0x80) { i += 1; return c; }
+  if ((c >> 5) == 0x6 && i + 1 < len) {
+    uint32_t cp = ((c & 0x1F) << 6) | (s[i + 1] & 0x3F);
+    i += 2; return cp;
+  }
+  if ((c >> 4) == 0xE && i + 2 < len) {
+    uint32_t cp = ((c & 0x0F) << 12) | ((s[i + 1] & 0x3F) << 6) |
+                  (s[i + 2] & 0x3F);
+    i += 3; return cp;
+  }
+  if ((c >> 3) == 0x1E && i + 3 < len) {
+    uint32_t cp = ((c & 0x07) << 18) | ((s[i + 1] & 0x3F) << 12) |
+                  ((s[i + 2] & 0x3F) << 6) | (s[i + 3] & 0x3F);
+    i += 4; return cp;
+  }
+  i += 1; return 0xFFFD;
+}
+
+inline void encode_utf8(uint32_t cp, std::string& out) {
+  if (cp < 0x80) {
+    out.push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
+inline bool is_whitespace(uint32_t cp) {
+  switch (cp) {
+    case ' ': case '\t': case '\n': case '\r':
+    case 0x00A0: case 0x1680: case 0x2028: case 0x2029:
+    case 0x202F: case 0x205F: case 0x3000:
+      return true;
+    default:
+      return cp >= 0x2000 && cp <= 0x200A;
+  }
+}
+
+inline bool is_control(uint32_t cp) {
+  if (cp == '\t' || cp == '\n' || cp == '\r') return false;  // treated as ws
+  if (cp < 0x20 || cp == 0x7F) return true;
+  // Common Cf (format) characters.
+  if (cp == 0x00AD || cp == 0xFEFF) return true;
+  if (cp >= 0x200B && cp <= 0x200F) return true;
+  if (cp >= 0x202A && cp <= 0x202E) return true;
+  if (cp >= 0x2060 && cp <= 0x2064) return true;
+  return false;
+}
+
+inline bool is_cjk(uint32_t cp) {
+  return (cp >= 0x4E00 && cp <= 0x9FFF) || (cp >= 0x3400 && cp <= 0x4DBF) ||
+         (cp >= 0x20000 && cp <= 0x2A6DF) || (cp >= 0x2A700 && cp <= 0x2B73F) ||
+         (cp >= 0x2B740 && cp <= 0x2B81F) || (cp >= 0x2B820 && cp <= 0x2CEAF) ||
+         (cp >= 0xF900 && cp <= 0xFAFF) || (cp >= 0x2F800 && cp <= 0x2FA1F);
+}
+
+inline bool is_punctuation(uint32_t cp) {
+  if ((cp >= 33 && cp <= 47) || (cp >= 58 && cp <= 64) ||
+      (cp >= 91 && cp <= 96) || (cp >= 123 && cp <= 126))
+    return true;
+  // Common Unicode punctuation blocks / characters.
+  if (cp >= 0x2010 && cp <= 0x2027) return true;   // dashes, quotes, bullets
+  if (cp >= 0x2030 && cp <= 0x205E) return true;   // permille .. general punct
+  if (cp == 0x00A1 || cp == 0x00A7 || cp == 0x00AB || cp == 0x00B6 ||
+      cp == 0x00B7 || cp == 0x00BB || cp == 0x00BF)
+    return true;
+  if (cp >= 0x3001 && cp <= 0x3003) return true;   // CJK comma/stop
+  if (cp >= 0x3008 && cp <= 0x3011) return true;   // CJK brackets
+  if (cp >= 0x3014 && cp <= 0x301F) return true;
+  if (cp == 0x30FB || cp == 0xFF01 || cp == 0xFF0C || cp == 0xFF0E ||
+      cp == 0xFF1A || cp == 0xFF1B || cp == 0xFF1F)
+    return true;
+  return false;
+}
+
+// Combining diacritical marks (category Mn slices BertNormalizer strips
+// after NFD when lowercasing).
+inline bool is_combining_mark(uint32_t cp) {
+  return (cp >= 0x0300 && cp <= 0x036F) || (cp >= 0x1AB0 && cp <= 0x1AFF) ||
+         (cp >= 0x1DC0 && cp <= 0x1DFF) || (cp >= 0x20D0 && cp <= 0x20FF);
+}
+
+// Lowercase + accent-strip one codepoint. Returns 0 when the codepoint
+// should be dropped (pure combining mark). Mirrors NFD-decompose ->
+// drop-Mn -> lowercase for the Latin-1 Supplement and Latin Extended-A
+// ranges, plus simple offset lowercasing for Greek/Cyrillic.
+inline uint32_t lower_strip(uint32_t cp) {
+  if (cp < 0x80) {
+    if (cp >= 'A' && cp <= 'Z') return cp + 32;
+    return cp;
+  }
+  if (is_combining_mark(cp)) return 0;
+  if (cp >= 0xC0 && cp <= 0xFF) {  // Latin-1 Supplement letters
+    static const char* tbl =
+        // 0xC0..0xDF: À Á Â Ã Ä Å Æ Ç È É Ê Ë Ì Í Î Ï Ð Ñ Ò Ó Ô Õ Ö × Ø Ù Ú Û Ü Ý Þ ß
+        "aaaaaa\0ceeeeiiii\0nooooo\0\0uuuuy\0\0"
+        // 0xE0..0xFF mirrors with lowercase input (ÿ -> y)
+        "aaaaaa\0ceeeeiiii\0nooooo\0\0uuuuy\0y";
+    char t = tbl[cp - 0xC0];
+    if (t) return static_cast<uint32_t>(t);
+    // Non-decomposing letters: lowercase only.
+    if (cp == 0xC6) return 0xE6;  // Æ
+    if (cp == 0xD0) return 0xF0;  // Ð
+    if (cp == 0xD7) return 0xD7;  // ×
+    if (cp == 0xD8) return 0xF8;  // Ø
+    if (cp == 0xDE) return 0xFE;  // Þ
+    return cp;
+  }
+  if (cp >= 0x100 && cp <= 0x17F) {  // Latin Extended-A
+    struct Range { uint32_t lo, hi; char base; };
+    static const Range ranges[] = {
+        {0x100, 0x105, 'a'}, {0x106, 0x10D, 'c'}, {0x10E, 0x111, 'd'},
+        {0x112, 0x11B, 'e'}, {0x11C, 0x123, 'g'}, {0x124, 0x127, 'h'},
+        {0x128, 0x131, 'i'}, {0x134, 0x135, 'j'}, {0x136, 0x138, 'k'},
+        {0x139, 0x142, 'l'}, {0x143, 0x148, 'n'}, {0x14A, 0x14B, 'n'},
+        {0x14C, 0x151, 'o'}, {0x154, 0x159, 'r'}, {0x15A, 0x161, 's'},
+        {0x162, 0x167, 't'}, {0x168, 0x173, 'u'}, {0x174, 0x175, 'w'},
+        {0x176, 0x178, 'y'}, {0x179, 0x17E, 'z'},
+    };
+    // Đ/đ (0x110/0x111) and ŋ do not NFD-decompose but lowercase within
+    // their range mapping above is the accepted approximation.
+    for (const auto& r : ranges)
+      if (cp >= r.lo && cp <= r.hi) return static_cast<uint32_t>(r.base);
+    return cp;
+  }
+  if (cp >= 0x391 && cp <= 0x3A9 && cp != 0x3A2) return cp + 0x20;  // Greek
+  if (cp >= 0x410 && cp <= 0x42F) return cp + 0x20;  // Cyrillic А..Я
+  if (cp >= 0x400 && cp <= 0x40F) return cp + 0x50;  // Ѐ..Џ
+  return cp;
+}
+
+// ------------------------------------------------------------- vocabulary
+
+struct SvHash {
+  size_t operator()(std::string_view sv) const {
+    // FNV-1a
+    size_t h = 1469598103934665603ull;
+    for (char c : sv) {
+      h ^= static_cast<unsigned char>(c);
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+};
+
+struct Model {
+  std::string vocab_blob;                 // concatenated token bytes
+  std::vector<std::string_view> tokens;   // id -> token view into blob
+  std::unordered_map<std::string_view, int32_t, SvHash> roots;
+  std::unordered_map<std::string_view, int32_t, SvHash> suffixes;  // sans ##
+  int32_t unk_id = 0;
+  bool lowercase = true;
+  int32_t max_input_chars = 100;
+};
+
+// ------------------------------------------------------- word -> wordpiece
+
+struct Word {
+  // Normalized UTF-8 bytes plus codepoint boundary offsets.
+  std::string bytes;
+  std::vector<int32_t> cp_off;  // size = n_cp + 1
+};
+
+// Greedy longest-match (HF WordPiece::tokenize semantics): whole word
+// becomes UNK if any position fails to match.
+inline void encode_word(const Model& m, const Word& w,
+                        std::vector<int32_t>& out) {
+  int32_t n_cp = static_cast<int32_t>(w.cp_off.size()) - 1;
+  if (n_cp == 0) return;
+  if (n_cp > m.max_input_chars) {
+    out.push_back(m.unk_id);
+    return;
+  }
+  size_t mark = out.size();
+  int32_t start = 0;
+  while (start < n_cp) {
+    int32_t end = n_cp;
+    int32_t found = -1;
+    const auto& map = (start == 0) ? m.roots : m.suffixes;
+    while (end > start) {
+      std::string_view sub(w.bytes.data() + w.cp_off[start],
+                           w.cp_off[end] - w.cp_off[start]);
+      auto it = map.find(sub);
+      if (it != map.end()) { found = it->second; break; }
+      --end;
+    }
+    if (found < 0) {
+      out.resize(mark);
+      out.push_back(m.unk_id);
+      return;
+    }
+    out.push_back(found);
+    start = end;
+  }
+}
+
+// Normalize + pre-tokenize + wordpiece one text into `out`.
+inline void encode_text(const Model& m, const char* s, int64_t len,
+                        std::vector<int32_t>& out, int32_t max_tokens) {
+  Word w;
+  w.bytes.reserve(32);
+  w.cp_off.reserve(33);
+  size_t start_size = out.size();
+  int64_t i = 0;
+  auto flush_word = [&]() {
+    if (!w.bytes.empty()) {
+      encode_word(m, w, out);
+      w.bytes.clear();
+      w.cp_off.clear();
+    }
+  };
+  w.cp_off.clear();
+  auto push_cp = [&](uint32_t cp) {
+    if (w.cp_off.empty()) w.cp_off.push_back(0);
+    encode_utf8(cp, w.bytes);
+    w.cp_off.push_back(static_cast<int32_t>(w.bytes.size()));
+  };
+  while (i < len) {
+    if (max_tokens > 0 &&
+        out.size() - start_size >= static_cast<size_t>(max_tokens))
+      break;
+    uint32_t cp = decode_utf8(s, len, i);
+    if (cp == 0 || cp == 0xFFFD || is_control(cp)) continue;
+    if (is_whitespace(cp)) { flush_word(); continue; }
+    if (m.lowercase) {
+      cp = lower_strip(cp);
+      if (cp == 0) continue;
+    }
+    if (is_cjk(cp) || is_punctuation(cp)) {
+      flush_word();
+      push_cp(cp);
+      flush_word();
+      continue;
+    }
+    push_cp(cp);
+  }
+  flush_word();
+  if (max_tokens > 0 &&
+      out.size() - start_size > static_cast<size_t>(max_tokens))
+    out.resize(start_size + max_tokens);
+}
+
+// ------------------------------------------------------ sentence splitting
+// Exact port of lddl_tpu/tokenization/sentences.py's rule-based splitter:
+// boundary = [.!?]+['")\]]* whitespace+ (?=["'([]?[A-Z0-9]), except after
+// abbreviations / initials when the boundary involves '.'.
+
+inline bool abbrev_core_matches(std::string_view core) {
+  static const char* kAbbrev[] = {
+      "mr", "mrs", "ms", "dr", "prof", "sr", "jr", "st", "vs", "etc", "inc",
+      "ltd", "co", "corp", "dept", "univ", "assn", "bros", "e.g", "i.e",
+      "cf", "al", "ave", "blvd", "rd", "fig", "no", "vol", "pp", "op",
+      "cit", "ca", "gen", "col", "sgt", "capt", "lt", "cmdr", "adm", "gov",
+      "sen", "rep", "rev", "hon", "pres", "supt", "det", "mt", "ft",
+      "approx"};
+  std::string low(core);
+  for (char& c : low)
+    if (c >= 'A' && c <= 'Z') c += 32;
+  for (const char* a : kAbbrev)
+    if (low == a) return true;
+  return false;
+}
+
+inline bool is_ascii_alpha(char c) {
+  return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z');
+}
+
+// Mirror of _looks_like_abbreviation(text_before) in sentences.py.
+inline bool looks_like_abbreviation(const char* s, int64_t start, int64_t end) {
+  // Last whitespace-separated token of s[start:end] (Python rsplit(None,1)).
+  int64_t e = end;
+  while (e > start && static_cast<unsigned char>(s[e - 1]) <= ' ') --e;
+  if (e == start) return false;
+  int64_t b = e;
+  while (b > start && static_cast<unsigned char>(s[b - 1]) > ' ') --b;
+  // lstrip('("\'[')
+  while (b < e && (s[b] == '(' || s[b] == '"' || s[b] == '\'' || s[b] == '['))
+    ++b;
+  if (b >= e) return false;
+  int64_t core_end = (s[e - 1] == '.') ? e - 1 : e;
+  std::string_view core(s + b, core_end - b);
+  if (core.empty()) return false;
+  if (abbrev_core_matches(core)) return true;
+  if (core.size() == 1 && core[0] >= 'A' && core[0] <= 'Z') return true;
+  // Dotted initialisms: (?:[A-Za-z]\.)+[A-Za-z]?
+  {
+    size_t i = 0;
+    bool any = false;
+    while (i + 1 < core.size() && is_ascii_alpha(core[i]) &&
+           core[i + 1] == '.') {
+      i += 2;
+      any = true;
+    }
+    if (any) {
+      if (i == core.size()) return true;
+      if (i + 1 == core.size() && is_ascii_alpha(core[i])) return true;
+    }
+  }
+  return false;
+}
+
+inline bool is_py_space(char c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == '\f' ||
+         c == '\v';
+}
+
+// Emit [start,end) byte ranges of sentences in text (ASCII-rule splitter;
+// multibyte UTF-8 content passes through inside sentences untouched).
+inline void split_sentences_rule(const char* s, int64_t len,
+                                 std::vector<int64_t>& bounds) {
+  auto strip_range = [&](int64_t b, int64_t e, int64_t& ob, int64_t& oe) {
+    while (b < e && is_py_space(s[b])) ++b;
+    while (e > b && is_py_space(s[e - 1])) --e;
+    ob = b; oe = e;
+  };
+  int64_t start = 0;
+  int64_t i = 0;
+  while (i < len) {
+    char c = s[i];
+    if (c != '.' && c != '!' && c != '?') { ++i; continue; }
+    int64_t punct_start = i;
+    while (i < len && (s[i] == '.' || s[i] == '!' || s[i] == '?')) ++i;
+    int64_t group_mid = i;  // end of [.!?]+ run
+    while (i < len && (s[i] == '\'' || s[i] == '"' || s[i] == ')' ||
+                       s[i] == ']'))
+      ++i;
+    int64_t group_end = i;  // end of group(1)
+    // \s+ (regex \s on str: space, \t..\r, \f, \v; ASCII view suffices here)
+    int64_t ws_end = i;
+    while (ws_end < len && is_py_space(s[ws_end])) ++ws_end;
+    if (ws_end == i) { continue; }  // no whitespace: not a boundary
+    // lookahead (?=["'([]?[A-Z0-9])
+    int64_t la = ws_end;
+    if (la < len && (s[la] == '"' || s[la] == '\'' || s[la] == '(' ||
+                     s[la] == '['))
+      ++la;
+    if (!(la < len &&
+          ((s[la] >= 'A' && s[la] <= 'Z') || (s[la] >= '0' && s[la] <= '9')))) {
+      i = group_end;
+      continue;
+    }
+    // Abbreviation guard applies when the group's last char or first char
+    // is '.' (sentences.py:46-48).
+    bool dotty = (s[group_end - 1] == '.') || (s[punct_start] == '.');
+    if (dotty && looks_like_abbreviation(s, start, group_end)) {
+      i = group_end;
+      continue;
+    }
+    int64_t ob, oe;
+    strip_range(start, group_end, ob, oe);
+    if (oe > ob) { bounds.push_back(ob); bounds.push_back(oe); }
+    start = ws_end;
+    i = ws_end;
+  }
+  int64_t ob, oe;
+  strip_range(start, len, ob, oe);
+  if (oe > ob) { bounds.push_back(ob); bounds.push_back(oe); }
+}
+
+struct ThreadSlice {
+  std::vector<int32_t> ids;
+  std::vector<int64_t> seq_ends;    // per-sequence end offset (local)
+  std::vector<int64_t> seq_owner;   // which input text produced it (docs mode)
+};
+
+void run_threads(int64_t n_items, int nthreads,
+                 const std::function<void(int64_t, int64_t, int)>& body) {
+  if (nthreads <= 1 || n_items <= 1) {
+    body(0, n_items, 0);
+    return;
+  }
+  if (nthreads > n_items) nthreads = static_cast<int>(n_items);
+  std::vector<std::thread> threads;
+  int64_t chunk = (n_items + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(n_items, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back(body, lo, hi, t);
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Build a model. vocab_blob: concatenated UTF-8 token bytes; offsets:
+// int64[n+1] boundaries; tokens are in id order.
+void* lddl_wp_create(const char* vocab_blob, const int64_t* offsets,
+                     int32_t n, int32_t unk_id, int32_t lowercase,
+                     int32_t max_input_chars) {
+  Model* m = new Model();
+  m->vocab_blob.assign(vocab_blob, offsets[n]);
+  m->tokens.resize(n);
+  m->roots.reserve(n * 2);
+  m->suffixes.reserve(n);
+  for (int32_t i = 0; i < n; ++i) {
+    std::string_view tok(m->vocab_blob.data() + offsets[i],
+                         offsets[i + 1] - offsets[i]);
+    m->tokens[i] = tok;
+    if (tok.size() > 2 && tok[0] == '#' && tok[1] == '#') {
+      m->suffixes.emplace(tok.substr(2), i);
+    } else {
+      m->roots.emplace(tok, i);
+    }
+  }
+  m->unk_id = unk_id;
+  m->lowercase = lowercase != 0;
+  m->max_input_chars = max_input_chars;
+  return m;
+}
+
+void lddl_wp_destroy(void* model) { delete static_cast<Model*>(model); }
+
+// Encode n_texts texts (concatenated blob + int64[n+1] offsets).
+// out_ids: int32 capacity `cap` (>= blob byte length is always enough);
+// out_offsets: int64[n_texts+1]. max_tokens<=0 means unlimited.
+// Returns total id count, or -1 if cap insufficient.
+int64_t lddl_wp_encode_batch(void* model, const char* blob,
+                             const int64_t* offsets, int64_t n_texts,
+                             int32_t max_tokens, int32_t* out_ids,
+                             int64_t cap, int64_t* out_offsets,
+                             int32_t nthreads) {
+  const Model& m = *static_cast<Model*>(model);
+  std::vector<ThreadSlice> slices(std::max<int64_t>(
+      1, std::min<int64_t>(nthreads <= 0 ? 1 : nthreads, n_texts)));
+  int real_threads = static_cast<int>(slices.size());
+  std::vector<std::pair<int64_t, int64_t>> ranges(real_threads);
+  int64_t chunk = (n_texts + real_threads - 1) / real_threads;
+  auto body = [&](int64_t lo, int64_t hi, int t) {
+    ThreadSlice& sl = slices[t];
+    ranges[t] = {lo, hi};
+    sl.ids.reserve((offsets[hi] - offsets[lo]) / 4 + 16);
+    for (int64_t k = lo; k < hi; ++k) {
+      encode_text(m, blob + offsets[k], offsets[k + 1] - offsets[k], sl.ids,
+                  max_tokens);
+      sl.seq_ends.push_back(static_cast<int64_t>(sl.ids.size()));
+    }
+  };
+  run_threads(n_texts, real_threads, body);
+  int64_t total = 0;
+  for (auto& sl : slices) total += static_cast<int64_t>(sl.ids.size());
+  if (total > cap) return -1;
+  int64_t pos = 0, seq = 0;
+  out_offsets[0] = 0;
+  for (int t = 0; t < real_threads; ++t) {
+    ThreadSlice& sl = slices[t];
+    if (!sl.ids.empty())
+      std::memcpy(out_ids + pos, sl.ids.data(), sl.ids.size() * 4);
+    for (int64_t e : sl.seq_ends) out_offsets[++seq] = pos + e;
+    pos += static_cast<int64_t>(sl.ids.size());
+  }
+  return total;
+}
+
+// Sentence-split one text; writes up to cap (start,end) byte-range pairs.
+// Returns number of sentences (caller retries with bigger buffer if > cap).
+int64_t lddl_split_sentences(const char* text, int64_t len,
+                             int64_t* out_bounds, int64_t cap) {
+  std::vector<int64_t> bounds;
+  split_sentences_rule(text, len, bounds);
+  int64_t n = static_cast<int64_t>(bounds.size()) / 2;
+  if (n <= cap)
+    std::memcpy(out_bounds, bounds.data(), bounds.size() * sizeof(int64_t));
+  return n;
+}
+
+// Full document front end: for each document (blob + offsets), rule-split
+// into sentences and WordPiece-encode each sentence, dropping sentences
+// that produce no tokens. Outputs ragged ids with per-sentence offsets and
+// per-document sentence counts.
+// Capacities: out_ids cap_ids (blob bytes is enough), out_sent_offsets
+// cap_sents+1 entries, out_doc_counts int64[n_docs].
+// Returns total ids, or -1 (cap_ids) / -2 (cap_sents) on overflow.
+int64_t lddl_encode_docs(void* model, const char* blob,
+                         const int64_t* offsets, int64_t n_docs,
+                         int32_t max_tokens_per_sent, int32_t* out_ids,
+                         int64_t cap_ids, int64_t* out_sent_offsets,
+                         int64_t cap_sents, int64_t* out_doc_counts,
+                         int32_t nthreads) {
+  const Model& m = *static_cast<Model*>(model);
+  int real_threads = static_cast<int>(std::max<int64_t>(
+      1, std::min<int64_t>(nthreads <= 0 ? 1 : nthreads, n_docs)));
+  struct DocSlice {
+    std::vector<int32_t> ids;
+    std::vector<int64_t> sent_ends;  // local id-offsets per kept sentence
+    std::vector<int64_t> doc_counts;
+  };
+  std::vector<DocSlice> slices(real_threads);
+  auto body = [&](int64_t lo, int64_t hi, int t) {
+    DocSlice& sl = slices[t];
+    std::vector<int64_t> bounds;
+    for (int64_t d = lo; d < hi; ++d) {
+      const char* text = blob + offsets[d];
+      int64_t len = offsets[d + 1] - offsets[d];
+      bounds.clear();
+      split_sentences_rule(text, len, bounds);
+      int64_t kept = 0;
+      for (size_t b = 0; b + 1 < bounds.size(); b += 2) {
+        size_t before = sl.ids.size();
+        encode_text(m, text + bounds[b], bounds[b + 1] - bounds[b], sl.ids,
+                    max_tokens_per_sent);
+        if (sl.ids.size() > before) {
+          sl.sent_ends.push_back(static_cast<int64_t>(sl.ids.size()));
+          ++kept;
+        }
+      }
+      sl.doc_counts.push_back(kept);
+    }
+  };
+  run_threads(n_docs, real_threads, body);
+  int64_t total_ids = 0, total_sents = 0, doc_i = 0;
+  for (auto& sl : slices) {
+    total_ids += static_cast<int64_t>(sl.ids.size());
+    total_sents += static_cast<int64_t>(sl.sent_ends.size());
+  }
+  if (total_ids > cap_ids) return -1;
+  if (total_sents > cap_sents) return -2;
+  int64_t pos = 0, sent = 0;
+  out_sent_offsets[0] = 0;
+  for (auto& sl : slices) {
+    if (!sl.ids.empty())
+      std::memcpy(out_ids + pos, sl.ids.data(), sl.ids.size() * 4);
+    for (int64_t e : sl.sent_ends) out_sent_offsets[++sent] = pos + e;
+    for (int64_t c : sl.doc_counts) out_doc_counts[doc_i++] = c;
+    pos += static_cast<int64_t>(sl.ids.size());
+  }
+  return total_ids;
+}
+
+// Decode: for each of n_seqs id ranges, emit the space-joined token string.
+// Outputs Arrow string-column buffers: out_offsets int32[n_seqs+1] and
+// out_data (cap_data bytes). Returns total data bytes, or -1 on overflow.
+int64_t lddl_decode_join(void* model, const int32_t* ids,
+                         const int64_t* offsets, int64_t n_seqs,
+                         char* out_data, int64_t cap_data,
+                         int32_t* out_offsets) {
+  const Model& m = *static_cast<Model*>(model);
+  int64_t pos = 0;
+  out_offsets[0] = 0;
+  for (int64_t s = 0; s < n_seqs; ++s) {
+    for (int64_t k = offsets[s]; k < offsets[s + 1]; ++k) {
+      std::string_view tok =
+          (ids[k] >= 0 && ids[k] < static_cast<int32_t>(m.tokens.size()))
+              ? m.tokens[ids[k]]
+              : std::string_view("[UNK]");
+      int64_t need = static_cast<int64_t>(tok.size()) +
+                     (k > offsets[s] ? 1 : 0);
+      if (pos + need > cap_data) return -1;
+      if (k > offsets[s]) out_data[pos++] = ' ';
+      std::memcpy(out_data + pos, tok.data(), tok.size());
+      pos += static_cast<int64_t>(tok.size());
+    }
+    out_offsets[s + 1] = static_cast<int32_t>(pos);
+  }
+  return pos;
+}
+
+int64_t lddl_native_abi_version() { return 3; }
+
+}  // extern "C"

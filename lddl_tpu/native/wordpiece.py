@@ -1,0 +1,183 @@
+"""ctypes front end for the native WordPiece encoder.
+
+``NativeWordPiece`` replaces the per-sentence Python tokenize loop of the
+reference (``lddl/dask/bert/pretrain.py:79-91``) with one GIL-free,
+multithreaded C call per partition. Output parity with HuggingFace's
+``BertTokenizerFast`` is covered by tests (``tests/test_native.py``) for
+ASCII/Latin accents/Greek/Cyrillic/CJK; codepoints outside those ranges
+skip accent-stripping (pass through unchanged) — a documented divergence
+for exotic scripts.
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+from .build import load_library
+
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_i32p = ctypes.POINTER(ctypes.c_int32)
+
+
+def _offsets_blob(texts):
+  """Concatenate texts -> (bytes blob, int64[n+1] offsets)."""
+  encoded = [t.encode('utf-8') if isinstance(t, str) else t for t in texts]
+  offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+  np.cumsum([len(e) for e in encoded], out=offsets[1:])
+  return b''.join(encoded), offsets
+
+
+class NativeWordPiece:
+  """C++ trie/longest-match WordPiece over a fixed id-ordered vocabulary."""
+
+  def __init__(self, vocab_words, unk_token='[UNK]', lowercase=True,
+               max_input_chars_per_word=100, num_threads=None):
+    self._lib = load_library()
+    self._vocab_words = list(vocab_words)
+    try:
+      unk_id = self._vocab_words.index(unk_token)
+    except ValueError:
+      unk_id = 0
+    blob, offsets = _offsets_blob(self._vocab_words)
+    self._model = self._lib.lddl_wp_create(
+        blob, offsets.ctypes.data_as(_i64p), len(self._vocab_words), unk_id,
+        1 if lowercase else 0, max_input_chars_per_word)
+    self._unk_id = unk_id
+    self.lowercase = lowercase
+    self._nthreads = num_threads or min(8, os.cpu_count() or 1)
+
+  @classmethod
+  def from_hf(cls, hf_tokenizer, num_threads=None):
+    """Build from a HuggingFace BERT tokenizer (same id order and casing)."""
+    vocab = hf_tokenizer.get_vocab()
+    words = [t for t, _ in sorted(vocab.items(), key=lambda kv: kv[1])]
+    lowercase = getattr(hf_tokenizer, 'do_lower_case', True)
+    return cls(words, unk_token=hf_tokenizer.unk_token, lowercase=lowercase,
+               num_threads=num_threads)
+
+  def __del__(self):
+    model = getattr(self, '_model', None)
+    if model:
+      self._lib.lddl_wp_destroy(model)
+      self._model = None
+
+  # NativeWordPiece is rebuilt (cheaply) rather than shipped across process
+  # boundaries: the ctypes model pointer is process-local.
+  def __getstate__(self):
+    raise TypeError('NativeWordPiece is not picklable; rebuild per process')
+
+  @property
+  def vocab_words(self):
+    return self._vocab_words
+
+  # ---------------------------------------------------------------- encode
+
+  def encode_batch_ids(self, texts, max_tokens=None):
+    """Encode texts -> (flat int32 ids, int64[n+1] offsets)."""
+    if not len(texts):
+      return np.zeros(0, np.int32), np.zeros(1, np.int64)
+    blob, offsets = _offsets_blob(texts)
+    cap = max(16, len(blob))
+    out_ids = np.empty(cap, dtype=np.int32)
+    out_offsets = np.empty(len(texts) + 1, dtype=np.int64)
+    total = self._lib.lddl_wp_encode_batch(
+        self._model, blob, offsets.ctypes.data_as(_i64p), len(texts),
+        max_tokens or 0, out_ids.ctypes.data_as(_i32p), cap,
+        out_offsets.ctypes.data_as(_i64p), self._nthreads)
+    if total < 0:
+      raise RuntimeError('native encode overflow (internal capacity bug)')
+    return out_ids[:total].copy(), out_offsets
+
+  def encode_docs(self, doc_texts, max_tokens_per_sent=None):
+    """Sentence-split + encode documents in one native call.
+
+    Returns (flat int32 ids, int64 sentence offsets into ids [n_sents+1],
+    int64 per-doc sentence counts). Sentences yielding zero tokens are
+    dropped (mirrors ``documents_from_lines``).
+    """
+    if not len(doc_texts):
+      return (np.zeros(0, np.int32), np.zeros(1, np.int64),
+              np.zeros(0, np.int64))
+    blob, offsets = _offsets_blob(doc_texts)
+    cap_ids = max(16, len(blob))
+    cap_sents = len(blob) + len(doc_texts) + 1
+    out_ids = np.empty(cap_ids, dtype=np.int32)
+    out_sent_offsets = np.empty(cap_sents + 1, dtype=np.int64)
+    out_doc_counts = np.empty(len(doc_texts), dtype=np.int64)
+    total = self._lib.lddl_encode_docs(
+        self._model, blob, offsets.ctypes.data_as(_i64p), len(doc_texts),
+        max_tokens_per_sent or 0, out_ids.ctypes.data_as(_i32p), cap_ids,
+        out_sent_offsets.ctypes.data_as(_i64p), cap_sents,
+        out_doc_counts.ctypes.data_as(_i64p), self._nthreads)
+    if total < 0:
+      raise RuntimeError('native encode_docs overflow (internal capacity bug)')
+    n_sents = int(out_doc_counts.sum())
+    return (out_ids[:total].copy(), out_sent_offsets[:n_sents + 1].copy(),
+            out_doc_counts)
+
+  def split_sentences(self, text):
+    """Rule-based sentence split (same semantics as the Python 'rules'
+    backend in ``lddl_tpu/tokenization/sentences.py``)."""
+    data = text.encode('utf-8')
+    cap = max(8, len(data) // 2 + 1)
+    out = np.empty(cap * 2, dtype=np.int64)
+    n = self._lib.lddl_split_sentences(data, len(data),
+                                       out.ctypes.data_as(_i64p), cap)
+    if n > cap:  # pathological input; retry with exact size
+      out = np.empty(n * 2, dtype=np.int64)
+      n = self._lib.lddl_split_sentences(data, len(data),
+                                         out.ctypes.data_as(_i64p), n)
+    bounds = out[:n * 2].reshape(-1, 2)
+    return [data[b:e].decode('utf-8') for b, e in bounds]
+
+  # ------------------------------------------------- token-level interface
+
+  def tokenize(self, text, max_length=None):
+    ids, _ = self.encode_batch_ids([text], max_tokens=max_length)
+    words = self._vocab_words
+    return [words[i] for i in ids]
+
+  def batch_tokenize(self, texts, max_length=None):
+    ids, offsets = self.encode_batch_ids(texts, max_tokens=max_length)
+    words = self._vocab_words
+    flat = [words[i] for i in ids]
+    return [flat[offsets[k]:offsets[k + 1]] for k in range(len(texts))]
+
+  # ---------------------------------------------------------------- decode
+
+  def decode_join_buffers(self, ids, offsets):
+    """ids ranges -> Arrow string-column buffers (int32 offsets, utf8 data).
+
+    Feed straight into ``pyarrow.StringArray.from_buffers`` for a zero-copy
+    column of space-joined token strings.
+    """
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    n = len(offsets) - 1
+    # Upper bound: every token expands to at most max(token_len) bytes plus
+    # a separator; use sum of per-id lengths computed cheaply via a lens LUT.
+    if not hasattr(self, '_lens_lut'):
+      self._lens_lut = np.array([len(w.encode('utf-8')) for w in
+                                 self._vocab_words], dtype=np.int64)
+    n_ids = int(offsets[-1])
+    cap = int(self._lens_lut[ids[:n_ids]].sum()) + n_ids + 16 if n_ids else 16
+    out_data = np.empty(cap, dtype=np.uint8)
+    out_offsets = np.empty(n + 1, dtype=np.int32)
+    total = self._lib.lddl_decode_join(
+        self._model, ids.ctypes.data_as(_i32p),
+        offsets.ctypes.data_as(_i64p), n,
+        out_data.ctypes.data_as(ctypes.c_char_p), cap,
+        out_offsets.ctypes.data_as(_i32p))
+    if total < 0:
+      raise RuntimeError('native decode overflow (internal capacity bug)')
+    return out_offsets, out_data[:total]
+
+  def decode_join(self, ids, offsets):
+    """ids ranges -> list of space-joined token strings."""
+    out_offsets, data = self.decode_join_buffers(ids, offsets)
+    buf = data.tobytes()
+    return [
+        buf[out_offsets[k]:out_offsets[k + 1]].decode('utf-8')
+        for k in range(len(out_offsets) - 1)
+    ]

@@ -1,0 +1,17 @@
+"""Device-side (JAX) and vectorized host kernels for the data pipeline.
+
+The reference's hot loops are per-token Python (masking,
+``lddl/dask/bert/pretrain.py:182-238``); here they are batched array
+programs: one masking call per partition over a padded ``[N, L]`` id
+matrix, jit-compiled onto the TPU when one is attached (host numpy
+otherwise).
+"""
+
+from .masking import (  # noqa: F401
+    assemble_pair_matrix,
+    mask_batch,
+    mask_batch_device,
+    mask_batch_host,
+    mask_partition_device,
+    resolve_mask_backend,
+)

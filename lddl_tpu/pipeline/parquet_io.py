@@ -55,47 +55,74 @@ def write_samples_partition(
   files are written even when empty, so the global bin-id set is always
   contiguous (the balancer consolidates empties away).
   """
+  cols = {
+      field: pa.array([r[field] for r in samples],
+                      type=schema.field(field).type)
+      for field in schema.names
+  }
+  return write_table_partition(
+      pa.table(cols),
+      out_dir,
+      partition_idx,
+      bin_size=bin_size,
+      nbins=nbins,
+      compression=compression,
+      output_format=output_format,
+  )
+
+
+def write_table_partition(
+    table,
+    out_dir,
+    partition_idx,
+    bin_size=None,
+    nbins=None,
+    compression='default',
+    output_format='parquet',
+):
+  """Columnar sibling of :func:`write_samples_partition`.
+
+  ``table``: a ``pyarrow.Table`` for the whole partition (no ``bin_id``
+  column; must contain ``num_tokens`` when binned). The bin split happens
+  via one stable argsort + per-bin ``Table.take`` (Arrow C++), avoiding
+  any per-row Python. Returns ``{bin_id_or_None: (path, num_samples)}``.
+  """
   if compression == 'default':
     compression = _default_compression()
   os.makedirs(out_dir, exist_ok=True)
 
-  def _table(rows, with_bin_id=None):
-    cols = {}
-    for field in schema.names:
-      cols[field] = pa.array([r[field] for r in rows], type=schema.field(field).type)
-    if with_bin_id is not None:
-      cols['bin_id'] = pa.array([with_bin_id] * len(rows), type=pa.int64())
-    return pa.table(cols)
-
-  def _write(table, path):
+  def _write(tbl, path):
     if output_format == 'parquet':
-      pq.write_table(table, path, compression=compression)
+      pq.write_table(tbl, path, compression=compression)
     elif output_format == 'txt':
       with open(path, 'w', encoding='utf-8') as f:
-        for row in table.to_pylist():
+        for row in tbl.to_pylist():
           f.write(repr(row) + '\n')
     else:
       raise ValueError(f'unknown output_format {output_format!r}')
 
   ext = 'parquet' if output_format == 'parquet' else 'txt'
-  out = {}
   if bin_size is None:
     path = os.path.join(out_dir, f'part.{partition_idx}.{ext}')
-    _write(_table(samples), path)
-    return {None: (path, len(samples))}
+    _write(table, path)
+    return {None: (path, table.num_rows)}
 
   if nbins is None:
     raise ValueError('nbins is required when bin_size is set')
-  bin_ids = compute_bin_ids([s['num_tokens'] for s in samples], bin_size,
+  bin_ids = compute_bin_ids(table.column('num_tokens').to_numpy(), bin_size,
                             nbins)
   order = np.argsort(bin_ids, kind='stable')
   sorted_bins = bin_ids[order]
   boundaries = np.searchsorted(sorted_bins, np.arange(nbins + 1))
+  out = {}
   for b in range(nbins):
-    rows = [samples[i] for i in order[boundaries[b]:boundaries[b + 1]]]
+    idx = order[boundaries[b]:boundaries[b + 1]]
+    tbl = table.take(pa.array(idx, type=pa.int64()))
+    tbl = tbl.append_column('bin_id',
+                            pa.array(np.full(len(idx), b, dtype=np.int64)))
     path = os.path.join(out_dir, f'part.{partition_idx}.{ext}_{b}')
-    _write(_table(rows, with_bin_id=b), path)
-    out[b] = (path, len(rows))
+    _write(tbl, path)
+    out[b] = (path, len(idx))
   return out
 
 

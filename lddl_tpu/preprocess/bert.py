@@ -33,7 +33,7 @@ import pyarrow as pa
 from ..core import attach_bool_arg, serialize_np_array
 from ..core.random import rng_from_key
 from ..pipeline.executor import Executor
-from ..pipeline.parquet_io import write_samples_partition
+from ..pipeline.parquet_io import write_samples_partition, write_table_partition
 from ..pipeline.shuffle import gather_partition
 from ..tokenization import split_sentences
 from .common import run_shuffled
@@ -249,6 +249,164 @@ def create_pairs_from_document(
   return instances
 
 
+def encode_documents(doc_texts, tokenizer, sentence_backend='rules',
+                     max_length=512):
+  """Raw document texts -> :class:`~lddl_tpu.preprocess.pairing.TokenizedDocs`.
+
+  With the native tokenizer and the 'rules' sentence backend the whole
+  front end (segmentation + WordPiece) is one multithreaded C call;
+  otherwise sentences are split in Python and encoded via the tokenizer's
+  batched id path. Zero-sentence documents are dropped (mirror of
+  ``documents_from_lines``).
+  """
+  from .pairing import TokenizedDocs
+  if tokenizer.native is not None and sentence_backend == 'rules':
+    flat, sent_offsets, doc_counts = tokenizer.native.encode_docs(
+        doc_texts, max_tokens_per_sent=max_length)
+  else:
+    sents_per_doc = []
+    for text in doc_texts:
+      sents = [s.strip() for s in split_sentences(text,
+                                                  backend=sentence_backend)]
+      sents_per_doc.append([s for s in sents if s])
+    flat_sents = [s for sents in sents_per_doc for s in sents]
+    flat, offsets = tokenizer.encode_batch_ids(flat_sents,
+                                               max_tokens=max_length)
+    lens = np.diff(offsets)
+    keep = lens > 0
+    sent_offsets = np.concatenate(
+        [[0], np.cumsum(lens[keep])]).astype(np.int64)
+    doc_counts = np.zeros(len(doc_texts), dtype=np.int64)
+    pos = 0
+    for d, sents in enumerate(sents_per_doc):
+      doc_counts[d] = int(keep[pos:pos + len(sents)].sum())
+      pos += len(sents)
+  nonempty = doc_counts > 0
+  return TokenizedDocs(flat, sent_offsets, doc_counts[nonempty])
+
+
+def _ragged_indices(lengths):
+  """(row_idx, within_row_idx) index arrays for ragged row extraction."""
+  n = len(lengths)
+  total = int(lengths.sum())
+  starts = np.zeros(n, dtype=np.int64)
+  np.cumsum(lengths[:-1], out=starts[1:])
+  row_idx = np.repeat(np.arange(n, dtype=np.int64), lengths)
+  col_idx = np.arange(total, dtype=np.int64) - np.repeat(starts, lengths)
+  return row_idx, col_idx
+
+
+def _string_column(tokenizer, flat_ids, offsets):
+  """Ragged id ranges -> Arrow string column of space-joined tokens
+  (zero-copy from native buffers when available)."""
+  bufs = tokenizer.decode_join_buffers(flat_ids, offsets)
+  if bufs is not None:
+    out_offsets, data = bufs
+    return pa.StringArray.from_buffers(
+        len(out_offsets) - 1, pa.py_buffer(out_offsets.tobytes()),
+        pa.py_buffer(data.tobytes()))
+  return pa.array(tokenizer.decode_join(flat_ids, offsets), type=pa.string())
+
+
+def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
+  """The fast path: tokenize -> plan pairs -> batched (device) masking ->
+  Arrow table. Returns a ``pyarrow.Table`` matching :func:`bert_schema`.
+
+  This is the TPU-first redesign of the reference's per-partition hot loop
+  (``lddl/dask/bert/pretrain.py:77-97,182-238``): token ids end-to-end,
+  contiguous-range pair planning, one batched masking call on the
+  accelerator, and zero-copy Arrow column assembly.
+  """
+  from ..ops import assemble_pair_matrix, mask_batch
+  from ..core.utils import serialize_u16_batch
+  from .pairing import plan_pairs_partition
+
+  from ..ops.masking import mask_partition_device, resolve_mask_backend
+
+  docs = encode_documents(doc_texts, tokenizer,
+                          sentence_backend=cfg.sentence_backend)
+  if len(docs) == 0:
+    names = bert_schema(cfg.masking).names
+    return pa.table({n: pa.array([], type=bert_schema(cfg.masking)
+                                 .field(n).type) for n in names})
+  a_ranges, b_ranges, is_random_next = plan_pairs_partition(
+      docs, rng, max_seq_length=cfg.target_seq_length,
+      short_seq_prob=cfg.short_seq_prob,
+      duplicate_factor=cfg.duplicate_factor)
+  flat_ids = docs.flat_ids
+  n = len(a_ranges)
+  na = (a_ranges[:, 1] - a_ranges[:, 0]).astype(np.int64)
+  nb = (b_ranges[:, 1] - b_ranges[:, 0]).astype(np.int64)
+  row_len = na + nb + 3
+  mask_mode = resolve_mask_backend(cfg.mask_backend) if cfg.masking else None
+  offs_a = np.zeros(n + 1, dtype=np.int64)
+  np.cumsum(na, out=offs_a[1:])
+  offs_b = np.zeros(n + 1, dtype=np.int64)
+  np.cumsum(nb, out=offs_b[1:])
+
+  if mask_mode == 'host':
+    # Padded-matrix path: assemble + mask + ragged re-extraction, all numpy.
+    mat, row_len32, na32 = assemble_pair_matrix(
+        flat_ids, a_ranges, b_ranges, tokenizer.cls_token_id,
+        tokenizer.sep_token_id, cfg.target_seq_length)
+    masked, picked = mask_batch(
+        mat, row_len32, na32, masked_lm_ratio=cfg.masked_lm_ratio,
+        vocab_size=tokenizer.vocab_size, mask_id=tokenizer.mask_token_id,
+        seed=mask_seed, backend='host')
+    ra, ca = _ragged_indices(na)
+    flat_a = masked[ra, ca + 1]
+    rb, cb = _ragged_indices(nb)
+    flat_b = masked[rb, cb + 2 + na[rb]]
+    ri, ci = np.nonzero(picked)  # row-major -> positions sorted per row
+    label_ids = mat[ri, ci].astype(np.int32)
+    k = picked.sum(axis=1).astype(np.int64)
+  else:
+    # Ragged gather straight from the flat partition ids (no id matrix).
+    ra, ca = _ragged_indices(na)
+    flat_a = flat_ids[a_ranges[ra, 0] + ca]
+    rb, cb = _ragged_indices(nb)
+    flat_b = flat_ids[b_ranges[rb, 0] + cb]
+    if mask_mode == 'device':
+      positions, new_ids, kk = mask_partition_device(
+          flat_ids, a_ranges, b_ranges, seq_len=cfg.target_seq_length,
+          masked_lm_ratio=cfg.masked_lm_ratio,
+          vocab_size=tokenizer.vocab_size,
+          mask_id=tokenizer.mask_token_id,
+          cls_id=tokenizer.cls_token_id, sep_id=tokenizer.sep_token_id,
+          seed=mask_seed)
+      k = kk.astype(np.int64)
+      pm = np.arange(positions.shape[1])[None, :] < k[:, None]
+      ri = np.nonzero(pm)[0]
+      ci = positions[pm].astype(np.int64)  # sorted within each row
+      in_a = ci < 1 + na[ri]
+      # Original (label) ids, read from the flat array via the ranges.
+      idx_a = a_ranges[ri, 0] + ci - 1
+      idx_b = b_ranges[ri, 0] + ci - 2 - na[ri]
+      label_ids = np.where(
+          in_a, flat_ids[np.where(in_a, idx_a, 0)],
+          flat_ids[np.where(in_a, 0, idx_b)]).astype(np.int32)
+      # Apply the post-masking ids into the ragged A/B columns.
+      newv = new_ids[pm].astype(flat_a.dtype)
+      tgt_a = offs_a[ri] + ci - 1
+      flat_a[tgt_a[in_a]] = newv[in_a]
+      tgt_b = offs_b[ri] + ci - 2 - na[ri]
+      flat_b[tgt_b[~in_a]] = newv[~in_a]
+
+  cols = {
+      'A': _string_column(tokenizer, flat_a, offs_a),
+      'B': _string_column(tokenizer, flat_b, offs_b),
+      'is_random_next': pa.array(is_random_next),
+      'num_tokens': pa.array(row_len.astype(np.uint16), type=pa.uint16()),
+  }
+  if cfg.masking:
+    offs_l = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(k, out=offs_l[1:])
+    cols['masked_lm_positions'] = pa.array(
+        serialize_u16_batch(ci.astype('<u2'), offs_l), type=pa.binary())
+    cols['masked_lm_labels'] = _string_column(tokenizer, label_ids, offs_l)
+  return pa.table(cols)
+
+
 def bert_schema(masking):
   fields = [
       ('A', pa.string()),
@@ -269,8 +427,10 @@ class BertPretrainConfig:
   vocab_file: str = None
   tokenizer_name: str = None
   lowercase: bool = True
-  tokenizer_backend: str = 'hf'
+  tokenizer_backend: str = 'auto'
   sentence_backend: str = 'auto'
+  engine: str = 'fast'  # 'fast' (columnar/device) | 'python' (reference-style)
+  mask_backend: str = 'auto'  # 'device' | 'host' | 'auto'
   target_seq_length: int = 128
   short_seq_prob: float = 0.1
   duplicate_factor: int = 5
@@ -298,15 +458,40 @@ def _get_tokenizer(cfg):
       backend=cfg.tokenizer_backend)
 
 
+def _mask_seed(seed, tgt_idx):
+  """Per-partition masking seed, independent of the pairing rng stream."""
+  return int(
+      np.random.SeedSequence([seed, tgt_idx, 0x6d61736b]).generate_state(1)[0])
+
+
 def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
   """Worker task: shuffled lines of one partition -> pair instances ->
   (binned) Parquet. Returns {bin_id_or_None: num_samples}."""
   del global_idx
   tokenizer = _get_tokenizer(cfg)
   lines = gather_partition(tgt_idx, spill_dir, cfg.seed)
+  rng = rng_from_key(cfg.seed, 'pairs', tgt_idx)
+
+  if cfg.engine == 'fast':
+    doc_texts = []
+    for line in lines:
+      _, text = split_id_text(line)
+      if text:
+        doc_texts.append(text)
+    table = process_partition_columnar(doc_texts, tokenizer, cfg, rng,
+                                       _mask_seed(cfg.seed, tgt_idx))
+    out = write_table_partition(
+        table,
+        out_dir,
+        tgt_idx,
+        bin_size=cfg.bin_size,
+        nbins=cfg.nbins,
+        output_format=cfg.output_format,
+    )
+    return {b: nrows for b, (_, nrows) in out.items()}
+
   documents = documents_from_lines(
       lines, tokenizer, sentence_backend=cfg.sentence_backend)
-  rng = rng_from_key(cfg.seed, 'pairs', tgt_idx)
   np_rng = np.random.Generator(
       np.random.Philox(key=[np.uint64(cfg.seed),
                             np.uint64(tgt_idx)]))
@@ -372,8 +557,15 @@ def attach_args(parser):
   parser.add_argument('--vocab-file', type=str, default=None)
   parser.add_argument('--tokenizer', type=str, default=None,
                       help='HF hub tokenizer name (needs egress)')
-  parser.add_argument('--tokenizer-backend', type=str, default='hf',
-                      choices=['hf', 'native'])
+  parser.add_argument('--tokenizer-backend', type=str, default='auto',
+                      choices=['auto', 'hf', 'native'])
+  parser.add_argument('--engine', type=str, default='fast',
+                      choices=['fast', 'python'],
+                      help='fast: columnar ids + batched/device masking; '
+                      'python: reference-style per-document loop')
+  parser.add_argument('--mask-backend', type=str, default='auto',
+                      choices=['auto', 'device', 'host'],
+                      help='where batched MLM masking runs (fast engine)')
   parser.add_argument('--sentence-backend', type=str, default='auto',
                       choices=['auto', 'punkt', 'rules'])
   parser.add_argument('--target-seq-length', type=int, default=128)
@@ -428,6 +620,8 @@ def main(args=None):
       lowercase=args.lowercase,
       tokenizer_backend=args.tokenizer_backend,
       sentence_backend=args.sentence_backend,
+      engine=args.engine,
+      mask_backend=args.mask_backend,
       target_seq_length=args.target_seq_length,
       short_seq_prob=args.short_seq_prob,
       duplicate_factor=args.duplicate_factor,

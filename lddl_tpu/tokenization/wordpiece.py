@@ -31,6 +31,11 @@ class BertWordPiece:
     return self._hf
 
   @property
+  def native(self):
+    """The native C++ encoder, or None when running on the HF backend."""
+    return self._native
+
+  @property
   def vocab_words(self):
     """Vocabulary tokens ordered by token id."""
     return self._vocab_words
@@ -93,6 +98,63 @@ class BertWordPiece:
       return [[words[i] for i in e.ids[:max_length]] for e in encodings]
     return [[words[i] for i in e.ids] for e in encodings]
 
+  def encode_batch_ids(self, texts, max_tokens=None):
+    """Tokenize many texts straight to ids.
+
+    Returns (flat int32 ids, int64 [n+1] offsets) — the representation the
+    fast preprocess pipeline works in (no Python token strings at all).
+    """
+    import numpy as np
+    if not len(texts):
+      return np.zeros(0, np.int32), np.zeros(1, np.int64)
+    if self._native is not None:
+      return self._native.encode_batch_ids(texts, max_tokens=max_tokens)
+    encodings = self._hf.backend_tokenizer.encode_batch(
+        list(texts), add_special_tokens=False)
+    id_lists = [
+        e.ids[:max_tokens] if max_tokens is not None else e.ids
+        for e in encodings
+    ]
+    offsets = np.zeros(len(id_lists) + 1, dtype=np.int64)
+    np.cumsum([len(ids) for ids in id_lists], out=offsets[1:])
+    total = int(offsets[-1])
+    flat = np.fromiter((i for ids in id_lists for i in ids),
+                       dtype=np.int32, count=total)
+    return flat, offsets
+
+  def decode_join(self, ids, offsets):
+    """Inverse of :meth:`encode_batch_ids` into space-joined strings."""
+    joiner = self._get_joiner()
+    if joiner is not None:
+      return joiner.decode_join(ids, offsets)
+    words = self._vocab_words
+    return [
+        ' '.join(words[i] for i in ids[offsets[k]:offsets[k + 1]])
+        for k in range(len(offsets) - 1)
+    ]
+
+  def decode_join_buffers(self, ids, offsets):
+    """ids ranges -> Arrow string-column (offsets, data) buffers, or None
+    when the native library is unavailable (callers fall back to
+    :meth:`decode_join`)."""
+    joiner = self._get_joiner()
+    if joiner is None:
+      return None
+    return joiner.decode_join_buffers(ids, offsets)
+
+  def _get_joiner(self):
+    """A native decoder even on the hf backend (built from vocab_words);
+    None when the native library cannot be built."""
+    if self._native is not None:
+      return self._native
+    if not hasattr(self, '_joiner'):
+      try:
+        from ..native import NativeWordPiece
+        self._joiner = NativeWordPiece(self._vocab_words, lowercase=False)
+      except Exception:
+        self._joiner = None
+    return self._joiner
+
   def convert_tokens_to_ids(self, tokens):
     t2i, unk = self._token_to_id, self._unk_id
     return [t2i.get(t, unk) for t in tokens]
@@ -101,21 +163,55 @@ class BertWordPiece:
     return self._hf.get_special_tokens_mask(ids, already_has_special_tokens=True)
 
 
+def _is_wordpiece_model(hf):
+  try:
+    return hf.backend_tokenizer.model.__class__.__name__ == 'WordPiece'
+  except Exception:
+    return False
+
+
 def load_bert_tokenizer(vocab_file=None, hub_name=None, lowercase=True,
-                        backend='hf'):
+                        backend='auto'):
   """Build a :class:`BertWordPiece` from a local vocab file (preferred on
-  egress-restricted TPU fleets) or a hub model name."""
-  from transformers import BertTokenizerFast
+  egress-restricted TPU fleets) or a hub model name.
+
+  backend:
+    'native' — this repo's C++ encoder (raises if it cannot be used);
+    'hf'     — HuggingFace fast tokenizer only;
+    'auto'   — native when the model is WordPiece and the library builds,
+               hf otherwise.
+
+  Hub names resolve through ``AutoTokenizer`` so non-WordPiece checkpoints
+  (e.g. ``microsoft/codebert-base``'s RoBERTa BPE) load correctly; local
+  ``vocab_file`` always means BERT WordPiece.
+  """
   if vocab_file is not None:
+    from transformers import BertTokenizerFast
     hf = BertTokenizerFast(
         vocab_file=os.path.abspath(os.path.expanduser(vocab_file)),
         do_lower_case=lowercase)
   elif hub_name is not None:
-    hf = BertTokenizerFast.from_pretrained(hub_name, do_lower_case=lowercase)
+    from transformers import AutoTokenizer
+    hf = AutoTokenizer.from_pretrained(hub_name, use_fast=True,
+                                       do_lower_case=lowercase)
+    if not hf.is_fast:
+      raise ValueError(
+          f'{hub_name} produced a slow tokenizer; batch tokenization '
+          'requires a fast (Rust) backend')
   else:
     raise ValueError('need vocab_file or hub_name')
   native = None
   if backend == 'native':
-    from ..native import wordpiece as native_wp
-    native = native_wp.NativeWordPiece.from_hf(hf)
+    if not _is_wordpiece_model(hf):
+      raise ValueError(
+          'tokenizer-backend native supports WordPiece models only '
+          f'(got {hf.backend_tokenizer.model.__class__.__name__})')
+    from ..native import NativeWordPiece
+    native = NativeWordPiece.from_hf(hf)
+  elif backend == 'auto' and _is_wordpiece_model(hf):
+    try:
+      from ..native import NativeWordPiece
+      native = NativeWordPiece.from_hf(hf)
+    except Exception:
+      native = None  # no compiler on this host; hf covers correctness
   return BertWordPiece(hf, native_encoder=native)

@@ -1,0 +1,166 @@
+"""Native C++ tokenizer: build, HF parity, sentence-split parity, decode."""
+
+import random
+
+import numpy as np
+import pytest
+
+pytest.importorskip('transformers')
+
+
+@pytest.fixture(scope='module')
+def native_mod():
+  try:
+    from lddl_tpu.native import build_library
+    build_library()
+  except Exception as e:  # no compiler on this host
+    pytest.skip(f'native library unavailable: {e}')
+  from lddl_tpu import native
+  return native
+
+
+@pytest.fixture(scope='module')
+def rich_vocab(tmp_path_factory):
+  words = ['[PAD]', '[UNK]', '[CLS]', '[SEP]', '[MASK]']
+  words += ['run', 'walk', 'talk', 'read', 'dog', 'cat', 'house', 'tree',
+            'the', 'a', 'and', 'cafe', 'francais', 'uber', 'strasse',
+            'naive', 'zurich', 'fast', 'slow', 'kind']
+  words += ['##' + s for s in ('ing', 'ed', 'er', 's', 'ly', 'ness', 'able')]
+  words += list('.,!?;:()[]"\'-0123456789')
+  words += ['##' + c for c in '0123456789']
+  words += ['中', '国', '人', '日', '本']
+  path = tmp_path_factory.mktemp('vocab') / 'rich_vocab.txt'
+  path.write_text('\n'.join(dict.fromkeys(words)) + '\n', encoding='utf-8')
+  return str(path)
+
+
+@pytest.fixture(scope='module')
+def hf_and_native(native_mod, rich_vocab):
+  from transformers import BertTokenizerFast
+  hf = BertTokenizerFast(vocab_file=rich_vocab, do_lower_case=True)
+  return hf, native_mod.NativeWordPiece.from_hf(hf)
+
+
+_SAMPLE_WORDS = [
+    'running', 'walked', 'dogs', 'cats', 'faster', 'slowly', 'kindness',
+    'readable', 'café', 'Français', 'Über', 'Straße', 'naïve', 'Zürich',
+    'xyzzy', 'qwerty123', '中国', '日本人', 'U.S.', 'Mr.', 'e.g.', '3.14',
+    'hello-world', '"quote"', "it's", 'the', 'a', 'and', 'ОЧЕНЬ', 'Δοκιμή',
+]
+
+
+class TestHfParity:
+
+  def test_tokenize_matches_hf(self, hf_and_native):
+    hf, nat = hf_and_native
+    r = random.Random(0)
+    for _ in range(500):
+      text = ' '.join(r.choice(_SAMPLE_WORDS) for _ in range(r.randrange(1, 12)))
+      if r.random() < 0.3:
+        text = text.capitalize() + r.choice('.!?')
+      assert nat.tokenize(text) == hf.tokenize(text), repr(text)
+
+  def test_batch_ids_match_hf(self, hf_and_native):
+    hf, nat = hf_and_native
+    texts = [' '.join(_SAMPLE_WORDS[i:i + 5]) for i in range(20)]
+    ids, offsets = nat.encode_batch_ids(texts)
+    encs = hf.backend_tokenizer.encode_batch(texts, add_special_tokens=False)
+    hf_flat = [i for e in encs for i in e.ids]
+    assert ids.tolist() == hf_flat
+    assert offsets.tolist() == list(
+        np.cumsum([0] + [len(e.ids) for e in encs]))
+
+  def test_max_tokens_truncation(self, hf_and_native):
+    _, nat = hf_and_native
+    toks = nat.tokenize('the dog and the cat and the tree', max_length=3)
+    assert len(toks) == 3
+
+  def test_empty_and_whitespace(self, hf_and_native):
+    hf, nat = hf_and_native
+    for text in ('', '   ', '\t\n', 'the'):
+      assert nat.tokenize(text) == hf.tokenize(text)
+
+  def test_unk_for_long_word(self, hf_and_native):
+    hf, nat = hf_and_native
+    w = 'x' * 150
+    assert nat.tokenize(w) == hf.tokenize(w) == ['[UNK]']
+
+  def test_threading_invariant(self, native_mod, rich_vocab):
+    from transformers import BertTokenizerFast
+    hf = BertTokenizerFast(vocab_file=rich_vocab, do_lower_case=True)
+    one = native_mod.NativeWordPiece.from_hf(hf, num_threads=1)
+    four = native_mod.NativeWordPiece.from_hf(hf, num_threads=4)
+    texts = [' '.join(_SAMPLE_WORDS) for _ in range(64)]
+    i1, o1 = one.encode_batch_ids(texts)
+    i4, o4 = four.encode_batch_ids(texts)
+    assert np.array_equal(i1, i4) and np.array_equal(o1, o4)
+
+
+class TestSentenceSplit:
+
+  def test_matches_python_rules(self, hf_and_native):
+    from lddl_tpu.tokenization.sentences import _rule_based_split
+    _, nat = hf_and_native
+    r = random.Random(1)
+    words = _SAMPLE_WORDS + ['Dr.', 'etc.', 'vs.', 'No.', '(A)', 'i.e.']
+    for _ in range(500):
+      parts = []
+      for _ in range(r.randrange(1, 5)):
+        k = r.randrange(2, 9)
+        parts.append(' '.join(r.choice(words) for _ in range(k)).capitalize()
+                     + r.choice('..!?'))
+      text = ' '.join(parts)
+      assert nat.split_sentences(text) == _rule_based_split(text), repr(text)
+
+  def test_encode_docs_matches_split_then_encode(self, hf_and_native):
+    _, nat = hf_and_native
+    docs = [
+        'The dog ran. The cat walked fast!',
+        'Kindness read the tree. Naïve café. Xyzzy!',
+        '',
+        '中国 the 日本人.',
+    ]
+    flat, sent_offsets, doc_counts = nat.encode_docs(docs)
+    # manual: split + encode + drop empties
+    exp_ids, exp_counts = [], []
+    for d in docs:
+      kept = 0
+      for s in nat.split_sentences(d):
+        ids, _ = nat.encode_batch_ids([s])
+        if len(ids):
+          exp_ids.append(ids.tolist())
+          kept += 1
+      exp_counts.append(kept)
+    assert doc_counts.tolist() == exp_counts
+    got = [
+        flat[sent_offsets[i]:sent_offsets[i + 1]].tolist()
+        for i in range(len(sent_offsets) - 1)
+    ]
+    assert got == exp_ids
+
+
+class TestDecode:
+
+  def test_decode_join_roundtrip(self, hf_and_native):
+    _, nat = hf_and_native
+    texts = ['the dog ran.', 'kindness readable café', '中国 3.14']
+    ids, offsets = nat.encode_batch_ids(texts)
+    joined = nat.decode_join(ids, offsets)
+    for text, j in zip(texts, joined):
+      assert j.split() == nat.tokenize(text)
+
+  def test_decode_join_buffers_arrow(self, hf_and_native):
+    import pyarrow as pa
+    _, nat = hf_and_native
+    ids, offsets = nat.encode_batch_ids(['the dog', 'cat ran fast'])
+    out_offsets, data = nat.decode_join_buffers(ids, offsets)
+    arr = pa.StringArray.from_buffers(
+        len(out_offsets) - 1, pa.py_buffer(out_offsets.tobytes()),
+        pa.py_buffer(data.tobytes()))
+    assert arr.to_pylist() == nat.decode_join(ids, offsets)
+
+  def test_not_picklable(self, hf_and_native):
+    import pickle
+    _, nat = hf_and_native
+    with pytest.raises(TypeError):
+      pickle.dumps(nat)
