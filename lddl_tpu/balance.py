@@ -67,6 +67,10 @@ def plan_shards(files, num_shards):
   ``n+1`` for the first ``total % num_shards`` shards and ``n`` after —
   the balanced ±1 contract (reference ``load_balance.py:159-168``).
   """
+  if num_shards <= 0:
+    raise ValueError(f'num_shards must be positive, got {num_shards}')
+  if not files:
+    raise ValueError('cannot plan shards from zero input files')
   total = sum(f.num_samples for f in files)
   n, r = divmod(total, num_shards)
   starts = [i * n + min(i, r) for i in range(num_shards + 1)]
@@ -113,10 +117,12 @@ def _materialize_shard(files, ranges, out_path, compression='snappy'):
   if pieces:
     out = pa.concat_tables(pieces)
   else:
-    # An empty bin still produces a (zero-row) shard so the bin-id set stays
+    # A shard whose slice is empty (more shards than samples) still gets a
+    # zero-row file with the real schema so the shard-index set stays
     # contiguous for the loader.
-    out = (pq.read_schema(files[0].path).empty_table()
-           if files else pa.table({}))
+    if not files:
+      raise ValueError('cannot materialize a shard from zero input files')
+    out = pq.read_schema(files[0].path).empty_table()
   pq.write_table(out, out_path, compression=compression)
   return out.num_rows
 
@@ -129,6 +135,13 @@ def balance(input_paths, output_dir, num_shards, comm, postfix=''):
   """
   paths = sorted(input_paths)
   files = count_samples(paths, comm)
+  total = sum(f.num_samples for f in files)
+  if total == 0 and comm.rank == 0:
+    # Legitimate for a bin no sample fell into (the preprocessor writes a
+    # zero-row file per (partition, bin)); loud because an all-empty sink
+    # means something upstream went wrong.
+    print(f'warning: balancing zero samples (postfix={postfix!r}); '
+          f'writing {num_shards} empty shards')
   plans = plan_shards(files, num_shards)
   meta = {}
   for s, ranges in enumerate(plans):

@@ -71,6 +71,7 @@ _COMMANDS = {
     'preprocess_bart_pretrain': preprocess_bart_pretrain,
     'preprocess_codebert_pretrain': preprocess_codebert_pretrain,
     'balance_shards': balance_shards,
+    'balance_dask_output': balance_shards,  # reference-compatible alias
     'generate_num_samples_cache': generate_num_samples_cache,
 }
 

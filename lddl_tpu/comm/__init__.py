@@ -3,5 +3,6 @@ from .backend import (
     FileBackend,
     JaxProcessBackend,
     NullBackend,
+    ensure_jax_distributed,
     get_backend,
 )

@@ -93,6 +93,7 @@ class FileBackend(CommBackend):
     self._timeout = timeout
     self._poll = poll_interval
     self._seq = 0
+    self._gc_upto = 0  # own op files below this seq have been deleted
     # Namespace op files by run id so a reused rendezvous dir (e.g. after a
     # crash/restart) never reads a previous run's stale payloads. All ranks
     # of one run must agree on run_id (env LDDL_COMM_RUN_ID, or a job id).
@@ -110,14 +111,49 @@ class FileBackend(CommBackend):
   def _path(self, seq, rank):
     return os.path.join(self._dir, f'{self._run_id}.op{seq}.rank{rank}')
 
-  def allgather_object(self, obj):
-    seq = self._seq
-    self._seq += 1
-    payload = pickle.dumps(obj)
+  def _progress_path(self, rank):
+    return os.path.join(self._dir, f'{self._run_id}.progress.rank{rank}')
+
+  def _write_atomic(self, payload, dst):
     fd, tmp = tempfile.mkstemp(dir=self._dir)
     with os.fdopen(fd, 'wb') as f:
       f.write(payload)
-    os.rename(tmp, self._path(seq, self._rank))
+    os.rename(tmp, dst)
+
+  def _collect_garbage(self, seq):
+    """Delete this rank's op files that no peer can still need.
+
+    A peer whose progress marker reads ``s`` has *completed* every
+    collective below ``s`` (it writes the marker before publishing its
+    payload for ``s``), so it will never re-read files of seq < s. Each
+    rank deletes only its own files, so deletion races cannot occur.
+    Without this, a long run grows one file per rank per collective
+    forever.
+    """
+    min_seq = seq
+    for r in range(self._world_size):
+      if r == self._rank:
+        continue
+      try:
+        with open(self._progress_path(r), 'rb') as f:
+          min_seq = min(min_seq, int(f.read()))
+      except (OSError, ValueError):
+        return  # peer not started yet (or marker mid-rename): nothing safe
+    for s in range(self._gc_upto, min_seq):
+      try:
+        os.remove(self._path(s, self._rank))
+      except OSError:
+        pass
+    self._gc_upto = max(self._gc_upto, min_seq)
+
+  def allgather_object(self, obj):
+    seq = self._seq
+    self._seq += 1
+    # Publish progress (highest collective this rank has *entered* — all
+    # below are fully read) before the payload, then reap dead files.
+    self._write_atomic(str(seq).encode(), self._progress_path(self._rank))
+    self._collect_garbage(seq)
+    self._write_atomic(pickle.dumps(obj), self._path(seq, self._rank))
     results = []
     deadline = time.monotonic() + self._timeout
     for r in range(self._world_size):
@@ -133,17 +169,65 @@ class FileBackend(CommBackend):
     return results
 
 
+def ensure_jax_distributed():
+  """Initialize the ``jax.distributed`` runtime once (idempotent).
+
+  Resolution order:
+    1. already initialized — no-op;
+    2. explicit ``LDDL_COORDINATOR_ADDRESS`` / ``LDDL_NUM_PROCESSES`` /
+       ``LDDL_PROCESS_ID`` env config (for CPU clusters and tests) — a
+       failure here raises, explicit config must not degrade silently;
+    3. ``jax.distributed.initialize()`` auto-detection (TPU pod metadata,
+       SLURM, …); when no cluster is detected the process continues
+       single-process with a warning.
+
+  Returns True when the multi-process runtime is up, False for the
+  single-process fallback.
+  """
+  import jax
+  if jax.distributed.is_initialized():
+    return True
+  addr = os.environ.get('LDDL_COORDINATOR_ADDRESS')
+  if addr:
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=int(os.environ['LDDL_NUM_PROCESSES']),
+        process_id=int(os.environ['LDDL_PROCESS_ID']))
+    return True
+  try:
+    jax.distributed.initialize()
+    return True
+  except ValueError as e:
+    # Only the specific "no cluster environment detected" outcome (jax
+    # leaves coordinator_address unset when auto-detection finds nothing)
+    # may degrade to single-process — e.g. `--comm jax` on a lone TPU-VM.
+    # Anything else (coordinator unreachable, pod metadata timeout) means
+    # a real multi-process world exists and MUST fail loudly: a host that
+    # silently continued as world_size=1 would race the true rank 0 over
+    # the shared sink while the other hosts hang waiting for it.
+    if 'coordinator_address' not in str(e):
+      raise
+    import warnings
+    warnings.warn(
+        f'jax.distributed.initialize() found no cluster ({e}); '
+        'continuing single-process')
+    return False
+
+
 class JaxProcessBackend(CommBackend):
   """Host-level collectives over a JAX multi-process (TPU pod) runtime.
 
-  Requires ``jax.distributed.initialize()`` to have been called (the
-  framework's CLIs do this when ``--comm jax`` is selected). Collectives
-  ride XLA's ICI/DCN transport via ``multihost_utils``.
+  Construction initializes ``jax.distributed`` via
+  :func:`ensure_jax_distributed` (idempotent), so selecting ``--comm jax``
+  in any CLI is sufficient — no separate bootstrap call. Collectives ride
+  XLA's ICI/DCN transport via ``multihost_utils``.
   """
 
-  def __init__(self):
+  def __init__(self, initialize=True):
     import jax
     self._jax = jax
+    if initialize:
+      ensure_jax_distributed()
 
   @property
   def rank(self):

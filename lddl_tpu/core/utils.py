@@ -135,7 +135,11 @@ def serialize_np_array(a):
   at corpus scale.
   """
   a = np.ascontiguousarray(a)
-  if a.ndim == 1 and a.dtype.isnative:
+  # Fast path only for simple scalar dtypes: structured ('V') dtypes need
+  # the full descr list (dtype.str collapses them to raw bytes) and object
+  # ('O') arrays must go through np.save so allow_pickle=False rejects them
+  # instead of serializing raw pointers.
+  if a.ndim == 1 and a.dtype.isnative and a.dtype.kind in 'biufc':
     return _npy_header(a.dtype.str, a.shape[0]) + a.tobytes()
   buf = io.BytesIO()
   np.save(buf, a, allow_pickle=False)

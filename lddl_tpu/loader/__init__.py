@@ -20,4 +20,19 @@ __all__ = [
     'BinnedIterator',
     'ParquetShardDataset',
     'ShuffleBuffer',
+    'SeqlenAwarePrefetcher',
+    'make_global_batch',
+    'prefetch_to_device',
 ]
+
+_DEVICE_EXPORTS = ('SeqlenAwarePrefetcher', 'make_global_batch',
+                   'prefetch_to_device')
+
+
+def __getattr__(name):
+  # Lazy: .device imports jax, which the host-only loader paths (and the
+  # preprocess pool workers that import this package) must not pay for.
+  if name in _DEVICE_EXPORTS:
+    from . import device
+    return getattr(device, name)
+  raise AttributeError(name)

@@ -56,7 +56,16 @@ class BertCollate:
     self._cls_id = tokenizer.cls_token_id
     self._sep_id = tokenizer.sep_token_id
     self._mask_id = tokenizer.mask_token_id
-    self._pad_id = tokenizer.pad_token_id or 0
+    if tokenizer.pad_token_id is None:
+      import warnings
+      warnings.warn(
+          'tokenizer defines no pad token; padding input_ids with id 0 — '
+          'for BPE vocabs id 0 is a real token (<s>), harmless for loss '
+          '(attention_mask covers pads) but visible to consumers '
+          'inspecting input_ids')
+      self._pad_id = 0
+    else:
+      self._pad_id = tokenizer.pad_token_id
     self._vocab_size = tokenizer.vocab_size
 
   def __call__(self, rows, seq_len, epoch, step):

@@ -81,6 +81,11 @@ class BinnedIterator:
         d.samples_per_rank_per_epoch // samples_per_batch_per_rank
         for d in datasets)
     consumed_per_epoch = batches_per_epoch * global_batch
+    if consumed_per_epoch == 0:
+      raise ValueError(
+          'dataset yields zero full batches per epoch (every bin holds '
+          f'fewer than {samples_per_batch_per_rank} samples per rank); '
+          f'cannot map samples_seen={samples_seen} to an epoch offset')
     return (samples_seen // consumed_per_epoch,
             (samples_seen % consumed_per_epoch) // global_batch)
 

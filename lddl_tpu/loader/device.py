@@ -22,32 +22,46 @@ import queue
 import threading
 
 import jax
-from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import NamedSharding
 
 
-def make_global_batch(batch, mesh, data_axis='data'):
-  """Shard a dict of per-process numpy arrays along ``data_axis``."""
+def make_global_batch(batch, mesh, data_axis=None, seq_axis=None):
+  """Shard a dict of per-process numpy arrays with the canonical batch
+  layout.
+
+  Layout comes from :func:`lddl_tpu.parallel.mesh.canonical_batch_spec`
+  (``P(('data','fsdp'), 'seq')`` restricted to the axes the mesh actually
+  has and to divisible dims) — so an fsdp>1 or seq>1 mesh gets the layout
+  ``make_train_step`` documents instead of silent replication over those
+  axes, while a plain ``Mesh(devices, ('data',))`` still works unchanged.
+  Pass ``data_axis`` (str or tuple) / ``seq_axis`` explicitly to override.
+  """
+  from ..parallel.mesh import canonical_batch_spec
   out = {}
   for k, v in batch.items():
-    spec = PartitionSpec(data_axis, *([None] * (v.ndim - 1)))
+    spec = canonical_batch_spec(mesh, v.shape, data_axis=data_axis,
+                                seq_axis=seq_axis)
     out[k] = jax.make_array_from_process_local_data(
         NamedSharding(mesh, spec), v)
   return out
 
 
-def prefetch_to_device(iterator, mesh=None, data_axis='data', size=2):
+def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
+                       size=2):
   """Yield device-resident batches, keeping up to ``size`` in flight.
 
   ``iterator`` yields numpy batch dicts (or micro-batch lists, which are
   transferred element-wise). With ``mesh=None`` batches are placed whole
-  on the default device.
+  on the default device. ``data_axis``/``seq_axis`` forward to
+  :func:`make_global_batch`.
   """
 
   def _put(item):
     if isinstance(item, (list, tuple)):
       return [_put(x) for x in item]
     if mesh is not None:
-      return make_global_batch(item, mesh, data_axis=data_axis)
+      return make_global_batch(item, mesh, data_axis=data_axis,
+                               seq_axis=seq_axis)
     return jax.device_put(item)
 
   q = queue.Queue(maxsize=size)

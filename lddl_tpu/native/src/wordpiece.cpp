@@ -594,6 +594,9 @@ int64_t lddl_decode_join(void* model, const int32_t* ids,
       std::memcpy(out_data + pos, tok.data(), tok.size());
       pos += static_cast<int64_t>(tok.size());
     }
+    // Arrow string offsets are int32; joined output past 2 GiB must fail
+    // loudly (callers split the batch), never wrap into corrupt offsets.
+    if (pos > INT32_MAX) return -2;
     out_offsets[s + 1] = static_cast<int32_t>(pos);
   }
   return pos;

@@ -161,7 +161,15 @@ class NativeWordPiece:
       self._lens_lut = np.array([len(w.encode('utf-8')) for w in
                                  self._vocab_words], dtype=np.int64)
     n_ids = int(offsets[-1])
-    cap = int(self._lens_lut[ids[:n_ids]].sum()) + n_ids + 16 if n_ids else 16
+    if n_ids:
+      # Out-of-range ids decode as '[UNK]' (5 bytes) in the native code;
+      # clip them to that length here instead of mis-indexing the LUT.
+      used = ids[:n_ids]
+      in_range = (used >= 0) & (used < len(self._lens_lut))
+      lens = np.where(in_range, self._lens_lut[np.where(in_range, used, 0)], 5)
+      cap = int(lens.sum()) + n_ids + 16
+    else:
+      cap = 16
     out_data = np.empty(cap, dtype=np.uint8)
     out_offsets = np.empty(n + 1, dtype=np.int32)
     total = self._lib.lddl_decode_join(
@@ -169,6 +177,10 @@ class NativeWordPiece:
         offsets.ctypes.data_as(_i64p), n,
         out_data.ctypes.data_as(ctypes.c_char_p), cap,
         out_offsets.ctypes.data_as(_i32p))
+    if total == -2:
+      raise ValueError(
+          'joined string column exceeds 2 GiB (Arrow int32 offset limit); '
+          'split the partition into smaller batches')
     if total < 0:
       raise RuntimeError('native decode overflow (internal capacity bug)')
     return out_offsets, out_data[:total]

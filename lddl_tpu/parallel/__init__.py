@@ -8,7 +8,7 @@ a ``jax.sharding.Mesh`` with data / fsdp / tensor / sequence axes, XLA
 collectives over ICI, and ring attention for long-context scaling.
 """
 
-from .mesh import MESH_AXES, batch_pspec, make_mesh
+from .mesh import MESH_AXES, batch_pspec, canonical_batch_spec, make_mesh
 from .ring import ring_attention
 
-__all__ = ['MESH_AXES', 'batch_pspec', 'make_mesh', 'ring_attention']
+__all__ = ['MESH_AXES', 'batch_pspec', 'canonical_batch_spec', 'make_mesh', 'ring_attention']

@@ -59,6 +59,35 @@ def batch_sharding(mesh, ndim=2, seq_dim=1):
   return NamedSharding(mesh, batch_pspec(ndim, seq_dim))
 
 
+def canonical_batch_spec(mesh, shape, data_axis=None, seq_axis=None):
+  """:func:`batch_pspec` restricted to what ``mesh`` and ``shape`` allow.
+
+  The single source of truth for placing one batch array: dim 0 over the
+  data axes the mesh actually has (``('data','fsdp')`` filtered to present
+  axes, else the mesh's first axis), dim 1 over ``seq`` only when the dim
+  is divisible by the seq-axis size (auxiliary 2-D arrays — padded
+  position lists etc. — are replicated along seq instead of erroring),
+  trailing dims replicated. ``data_axis`` (str or tuple) / ``seq_axis``
+  override; ``seq_axis=False`` forbids seq sharding.
+  """
+  names = set(mesh.axis_names)
+  if data_axis is None:
+    present = tuple(a for a in ('data', 'fsdp') if a in names)
+    data_axis = present if present else mesh.axis_names[0]
+  if seq_axis is None and 'seq' in names:
+    seq_axis = 'seq'
+  if seq_axis:
+    axes = (seq_axis,) if isinstance(seq_axis, str) else tuple(seq_axis)
+    seq_size = int(np.prod([mesh.shape[a] for a in axes]))
+  else:
+    seq_axis, seq_size = None, 1
+  spec = [None] * len(shape)
+  spec[0] = data_axis
+  if seq_axis is not None and len(shape) > 1 and shape[1] % seq_size == 0:
+    spec[1] = seq_axis
+  return P(*spec)
+
+
 def mesh_summary(mesh):
   shape = collections.OrderedDict(zip(mesh.axis_names, mesh.devices.shape))
   return ', '.join(f'{k}={v}' for k, v in shape.items())

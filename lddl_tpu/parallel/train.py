@@ -21,7 +21,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..loader.bert import IGNORE_INDEX
 from ..models import spec_for_param
-from .mesh import batch_pspec
+from .mesh import canonical_batch_spec
 
 
 def param_shardings(mesh, abs_params):
@@ -115,6 +115,6 @@ def shard_batch(batch, mesh):
   """Place a host batch dict onto the mesh with the canonical data layout."""
   return {
       k: jax.device_put(
-          v, NamedSharding(mesh, batch_pspec(v.ndim, 1 if v.ndim > 1 else None)))
+          v, NamedSharding(mesh, canonical_batch_spec(mesh, v.shape)))
       for k, v in batch.items()
   }

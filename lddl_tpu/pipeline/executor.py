@@ -17,12 +17,25 @@ collectives.
 import concurrent.futures as _cf
 import multiprocessing as _mp
 import os
+import sys
 
 from ..comm import NullBackend
 
 
 def _run_task(fn, global_index, task):
   return global_index, fn(task, global_index)
+
+
+def _default_mp_context():
+  """fork is fastest, but forking a process that has initialized JAX (its
+  runtime holds locks in background threads) can deadlock the child — so
+  once ``jax`` is imported anywhere in the process, pool workers come from
+  a clean forkserver instead."""
+  if 'jax' in sys.modules and 'forkserver' in _mp.get_all_start_methods():
+    return _mp.get_context('forkserver')
+  if 'jax' in sys.modules:
+    return _mp.get_context('spawn')
+  return None  # platform default (fork on Linux)
 
 
 class Executor:
@@ -32,6 +45,9 @@ class Executor:
     if num_local_workers is None:
       num_local_workers = max(1, (os.cpu_count() or 1))
     self._num_local_workers = num_local_workers
+    # An explicit start method sticks; otherwise the context is resolved at
+    # map() time so a jax import *after* construction still switches the
+    # pool off fork.
     self._mp_context = (_mp.get_context(mp_start_method)
                         if mp_start_method else None)
 
@@ -63,7 +79,7 @@ class Executor:
     else:
       with _cf.ProcessPoolExecutor(
           max_workers=min(self._num_local_workers, len(my_indices)),
-          mp_context=self._mp_context) as pool:
+          mp_context=self._mp_context or _default_mp_context()) as pool:
         futures = [pool.submit(_run_task, fn, i, tasks[i]) for i in my_indices]
         for fut in futures:
           local_results.append(fut.result())

@@ -532,6 +532,36 @@ def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
     from ..tokenization.sentences import resolve_backend
     resolved = executor.comm.broadcast_object(resolve_backend(), root=0)
     cfg = dataclasses.replace(cfg, sentence_backend=resolved)
+  if cfg.tokenizer_backend == 'auto':
+    # Same principle: 'auto' must not resolve per worker (native needs a
+    # compiler; a heterogeneous fleet would silently emit mixed token
+    # streams for exotic scripts). Probe once on root, broadcast the
+    # decision; a worker that then cannot honor it fails loudly.
+    local = None
+    if executor.comm.rank == 0:
+      local = 'native' if _get_tokenizer(cfg).native is not None else 'hf'
+    resolved = executor.comm.broadcast_object(local, root=0)
+    cfg = dataclasses.replace(cfg, tokenizer_backend=resolved)
+  if cfg.masking and cfg.engine == 'fast' and cfg.mask_backend == 'auto':
+    # Masking backends have independent RNG streams, so which one runs is
+    # part of the output contract: resolve once here, not per pool worker
+    # (workers racing for an exclusive accelerator would otherwise make
+    # shard bits depend on OS scheduling). Pool workers cannot share one
+    # chip, so 'device' only applies to single-worker executors until the
+    # per-host device feeder lands.
+    local = None
+    if executor.comm.rank == 0:
+      from ..ops.masking import resolve_mask_backend
+      local = resolve_mask_backend('auto')
+      if local == 'device' and executor.num_local_workers > 1:
+        local = 'host'
+    resolved = executor.comm.broadcast_object(local, root=0)
+    cfg = dataclasses.replace(cfg, mask_backend=resolved)
+  if executor.comm.rank == 0:
+    mask = (cfg.mask_backend
+            if cfg.masking and cfg.engine == 'fast' else 'off')
+    print(f'preprocess backends: tokenizer={cfg.tokenizer_backend} '
+          f'sentences={cfg.sentence_backend} mask={mask}')
   return run_shuffled(
       corpus,
       sink_dir,

@@ -4,6 +4,7 @@ import os
 
 import pyarrow as pa
 import pyarrow.parquet as pq
+import pytest
 
 from lddl_tpu.balance import (
     NUM_SAMPLES_CACHE,
@@ -54,6 +55,20 @@ class TestPlan:
     plans = plan_shards([File('f', 2)], 4)
     sizes = [sum(b - a for _, a, b in p) for p in plans]
     assert sizes == [1, 1, 0, 0]
+
+  def test_zero_input_files_is_loud(self):
+    with pytest.raises(ValueError, match='zero input files'):
+      plan_shards([], 4)
+
+  def test_zero_total_samples_plans_empty_shards(self):
+    # A bin no sample fell into still has (zero-row) per-partition files;
+    # the plan is all-empty shards, not a crash.
+    plans = plan_shards([File('f0', 0), File('f1', 0)], 4)
+    assert plans == [[], [], [], []]
+
+  def test_nonpositive_num_shards_is_loud(self):
+    with pytest.raises(ValueError, match='num_shards'):
+      plan_shards([File('f', 2)], 0)
 
 
 class TestBalanceDirectory:
@@ -115,6 +130,59 @@ def _balance_worker(rank, world, rdzv, indir, outdir, q):
   comm = FileBackend(rdzv, rank, world, timeout=60.0)
   meta = balance_directory(indir, outdir, 4, comm)
   q.put((rank, meta))
+
+
+def _jax_balance_worker(rank, world, port, indir, outdir, q):
+  os.environ['JAX_PLATFORMS'] = 'cpu'
+  os.environ['LDDL_COORDINATOR_ADDRESS'] = f'localhost:{port}'
+  os.environ['LDDL_NUM_PROCESSES'] = str(world)
+  os.environ['LDDL_PROCESS_ID'] = str(rank)
+  import jax
+  jax.config.update('jax_platforms', 'cpu')
+  from lddl_tpu.comm import get_backend
+  comm = get_backend('jax')
+  meta = balance_directory(indir, outdir, 4, comm)
+  q.put((rank, meta))
+
+
+def test_balance_under_two_jax_processes(tmp_path):
+  """The TPU-pod path end-to-end: the balancer's count-allreduce and
+  barriers riding JaxProcessBackend across two real processes."""
+  import socket
+  with socket.socket() as s:
+    s.bind(('localhost', 0))
+    port = s.getsockname()[1]
+  indir = tmp_path / 'in'
+  indir.mkdir()
+  _write_shard(indir, 'part.0.parquet', list(range(9)))
+  _write_shard(indir, 'part.1.parquet', list(range(5)))
+  out_single = tmp_path / 'out_single'
+  meta_single = balance_directory(str(indir), str(out_single), 4,
+                                  NullBackend())
+  world = 2
+  out_jax = tmp_path / 'out_jax'
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [
+      ctx.Process(
+          target=_jax_balance_worker,
+          args=(r, world, port, str(indir), str(out_jax), q))
+      for r in range(world)
+  ]
+  for p in procs:
+    p.start()
+  metas = {}
+  for _ in range(world):
+    rank, meta = q.get(timeout=180)
+    metas[rank] = meta
+  for p in procs:
+    p.join(timeout=60)
+    assert p.exitcode == 0
+  assert metas[0] == metas[1] == meta_single
+  for name in meta_single:
+    a = pq.read_table(os.path.join(str(out_single), name))
+    b = pq.read_table(os.path.join(str(out_jax), name))
+    assert a.equals(b)
 
 
 def test_balance_two_ranks_matches_single(tmp_path):

@@ -50,6 +50,86 @@ def test_file_backend_three_ranks(tmp_path):
     assert root_val == 'from-1'
 
 
+def _file_gc_worker(rank, world, d, q):
+  b = FileBackend(d, rank, world, timeout=30.0)
+  for i in range(20):
+    b.allgather_object(i)
+  b.barrier()
+  q.put(rank)
+
+
+def test_file_backend_garbage_collects(tmp_path):
+  """Op files from long runs must be reaped, not grow unboundedly."""
+  world = 2
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [
+      ctx.Process(target=_file_gc_worker, args=(r, world, str(tmp_path), q))
+      for r in range(world)
+  ]
+  for p in procs:
+    p.start()
+  for _ in range(world):
+    q.get(timeout=60)
+  for p in procs:
+    p.join(timeout=30)
+    assert p.exitcode == 0
+  # 21 collectives ran; all but the last few op files (bounded by rank
+  # skew at exit, < world) must be gone. Progress markers are 1/rank.
+  op_files = [f for f in os.listdir(tmp_path) if '.op' in f]
+  assert len(op_files) <= 2 * world * world
+
+
+def _jax_backend_worker(rank, world, port, q):
+  os.environ['JAX_PLATFORMS'] = 'cpu'
+  os.environ['LDDL_COORDINATOR_ADDRESS'] = f'localhost:{port}'
+  os.environ['LDDL_NUM_PROCESSES'] = str(world)
+  os.environ['LDDL_PROCESS_ID'] = str(rank)
+  # The machine may pin a hardware platform via an early jax import
+  # (sitecustomize); override after import, like conftest does.
+  import jax
+  jax.config.update('jax_platforms', 'cpu')
+  b = get_backend('jax')
+  assert b.rank == rank and b.world_size == world
+  got = b.allgather_object({'rank': rank, 'payload': 'x' * (rank + 1) * 100})
+  total = b.allreduce_sum(np.full((4,), rank + 1, dtype=np.int64))
+  b.barrier()
+  root_val = b.broadcast_object(f'from-{rank}', root=1)
+  q.put((rank, got, total.tolist(), root_val))
+
+
+def test_jax_backend_two_processes():
+  """The flagship TPU-pod path (JaxProcessBackend) on a 2-process CPU
+  world: get_backend('jax') must bootstrap jax.distributed itself."""
+  import socket
+  with socket.socket() as s:
+    s.bind(('localhost', 0))
+    port = s.getsockname()[1]
+  world = 2
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [
+      ctx.Process(target=_jax_backend_worker, args=(r, world, port, q))
+      for r in range(world)
+  ]
+  for p in procs:
+    p.start()
+  results = {}
+  for _ in range(world):
+    rank, got, total, root_val = q.get(timeout=180)
+    results[rank] = (got, total, root_val)
+  for p in procs:
+    p.join(timeout=60)
+    assert p.exitcode == 0
+  for rank in range(world):
+    got, total, root_val = results[rank]
+    assert [g['rank'] for g in got] == [0, 1]
+    # Uneven payload sizes exercise the pad-to-max gather path.
+    assert got[1]['payload'] == 'x' * 200
+    assert total == [3, 3, 3, 3]  # (0+1) + (1+1)
+    assert root_val == 'from-1'
+
+
 def test_get_backend_env(tmp_path, monkeypatch):
   monkeypatch.setenv('LDDL_COMM', 'file')
   monkeypatch.setenv('LDDL_COMM_DIR', str(tmp_path))

@@ -304,6 +304,13 @@ class TestBinnedIterator:
     epoch, off = BinnedIterator.epoch_and_offset_of(datasets, 8, 1, 8 * 8 + 24)
     assert (epoch, off) == (1, 3)
 
+  def test_epoch_offset_zero_batches_is_loud(self, binned_shards):
+    datasets = self._datasets(binned_shards)
+    # Batch larger than any bin's per-rank sample count -> zero full
+    # batches per epoch; resume mapping must fail loudly, not divide by 0.
+    with pytest.raises(ValueError, match='zero full batches'):
+      BinnedIterator.epoch_and_offset_of(datasets, 1000, 1, 5)
+
   def test_drop_last_partial_batches(self, binned_shards):
     datasets = self._datasets(binned_shards)
     # 32 samples per bin, batch 5 -> 6 full batches per bin, 2 dropped.
