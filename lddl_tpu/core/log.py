@@ -67,9 +67,13 @@ class DatasetLogger:
     return self._rank
 
   def _make_logger(self, name, filename):
-    # Key the process-global logger by instance too, so two DatasetLoggers
-    # with different log_dir/log_level never share (and half-apply) config.
-    logger = logging.getLogger(f'{name}@{id(self):x}')
+    # Key the process-global logger by configuration, so two DatasetLoggers
+    # with different log_dir/log_level never share (and half-apply) config,
+    # while identical configs reuse one logger instead of stacking duplicate
+    # handlers. (Keying by id() is unsound: a GC'd instance's id can be
+    # reused, silently inheriting the dead instance's handlers.)
+    logger = logging.getLogger(
+        f'{name}@{self._log_dir}@{logging.getLevelName(self._log_level)}')
     logger.setLevel(self._log_level)
     fmt = logging.Formatter(
         'lddl_tpu - %(asctime)s - %(filename)s:%(lineno)d:%(funcName)s '

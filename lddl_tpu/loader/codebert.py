@@ -90,6 +90,8 @@ def get_codebert_pretrain_data_loader(
     micro_batch_size=None,
     comm=None,
     tokenizer=None,
+    log_dir=None,
+    log_level=None,
 ):
   """Loader over balanced CodeBERT shards; mirrors
   :func:`lddl_tpu.loader.get_bert_pretrain_data_loader`."""
@@ -121,4 +123,6 @@ def get_codebert_pretrain_data_loader(
       start_epoch=start_epoch,
       samples_seen=samples_seen,
       micro_batch_size=micro_batch_size,
-      comm=comm)
+      comm=comm,
+      log_dir=log_dir,
+      log_level=log_level)

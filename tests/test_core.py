@@ -130,3 +130,63 @@ def test_logger_scopes(tmp_path):
   with pytest.raises(ValueError):
     lg.to('galaxy')
   assert os.path.exists(tmp_path / 'node-0_rank-1.log')
+
+
+class _StubComm:
+  """Fixed-world comm stub: rank r of a preset world of gathered objects."""
+
+  def __init__(self, rank, gathered_hosts):
+    self._rank = rank
+    self._hosts = gathered_hosts
+
+  @property
+  def rank(self):
+    return self._rank
+
+  @property
+  def world_size(self):
+    return len(self._hosts)
+
+  def allgather_object(self, obj):
+    import socket
+    if obj == socket.gethostname():
+      return list(self._hosts)
+    # env-local_rank path gathers ints: synthesize ranks-within-host order.
+    out = []
+    seen = {}
+    for h in self._hosts:
+      out.append(seen.setdefault(h, [0, 0])[0])
+      seen[h][0] += 1
+    return out
+
+
+class TestTopology:
+
+  def test_single_process(self):
+    from lddl_tpu.core.topology import discover_topology
+    from lddl_tpu.comm import NullBackend
+    t = discover_topology(NullBackend())
+    assert t == (0, 1, 0, 0, 1)
+
+  def test_hostname_grouping(self, monkeypatch):
+    from lddl_tpu.core.topology import discover_topology
+    import socket
+    monkeypatch.delenv('LDDL_LOCAL_RANK', raising=False)
+    monkeypatch.delenv('LOCAL_RANK', raising=False)
+    me = socket.gethostname()
+    # 2 nodes x 2 procs; this process is rank 2 (first proc of node "other"
+    # would be wrong — ranks 0,1 on `me`, 2,3 on `me` again means 1 node).
+    hosts = [me, 'nodeB', me, 'nodeB']
+    t = discover_topology(_StubComm(2, hosts))
+    assert t.world_size == 4
+    assert t.node_rank == 0  # `me` appeared first (rank 0)
+    assert t.local_rank == 1  # ranks 0 and 2 are on `me`; 2 is second
+    assert t.nproc_per_node == 2
+
+  def test_env_local_rank(self, monkeypatch):
+    from lddl_tpu.core.topology import discover_topology
+    monkeypatch.setenv('LDDL_LOCAL_RANK', '1')
+    t = discover_topology(_StubComm(3, ['a', 'a', 'b', 'b']))
+    assert t.local_rank == 1
+    assert t.nproc_per_node == 2  # max gathered local_rank (1) + 1
+    assert t.node_rank == 1  # rank 3 // 2

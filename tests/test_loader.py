@@ -335,3 +335,23 @@ class TestBinnedIterator:
     assert len(loader) == 5
     assert len(list(loader)) == 5
     assert len(loader) == 8  # full again after the resumed epoch
+
+
+class TestLoggerWiring:
+
+  def test_log_dir_and_droplast_accounting(self, binned_shards, tiny_vocab,
+                                           tmp_path):
+    log_dir = tmp_path / 'dataset_logs'
+    # batch 5 over 32 samples/bin -> 2 samples dropped per bin per epoch.
+    _mk_loader(binned_shards, tiny_vocab, batch_size_per_rank=5,
+               log_dir=str(log_dir))
+    node_log = log_dir / 'node-0.log'
+    assert node_log.exists()
+    text = node_log.read_text()
+    assert 'drop-last tail' in text
+    # 2 bins x (32 % 5) = 4 dropped of 64 total.
+    assert '4 of 64 samples/epoch' in text
+
+  def test_no_log_dir_still_works(self, binned_shards, tiny_vocab):
+    loader = _mk_loader(binned_shards, tiny_vocab)
+    assert len(loader) == 8
