@@ -1,0 +1,218 @@
+"""Offline validation of the sequence-binning contract from harness dumps.
+
+Reads the per-rank ``lens_<rank>.npz`` files written by
+``benchmarks/train_bench.py --seq-len-dir`` and verifies the three
+invariants the reference checks post-hoc
+(``/root/reference/benchmarks/make_training_seqlen_plots.py:59-160``):
+
+  1. **cross-rank agreement** — every rank saw the same bin (padded
+     length) at every iteration (the zero-communication bin draw really
+     is world-identical);
+  2. **bin tightness** — per batch, ``max_len − min_len ≤ bin_size`` and
+     ``max_len ≤ padded_len``;
+  3. **padding waste** — ratio of padded zeros to real tokens, the number
+     binning exists to minimize.
+
+Prints one human-readable report + one machine-readable JSON line; exits
+nonzero when an invariant fails. With matplotlib available and
+``--out-dir`` given, also renders the reference's five plots (rank diff,
+min/max scatter, global diff, seq-len histogram, padded-zero histogram).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def collect(in_dir):
+  """Load every lens_<rank>.npz under ``in_dir`` → {rank: dict of arrays}."""
+  out = {}
+  for path in glob.glob(os.path.join(in_dir, '**', 'lens_*.npz'),
+                        recursive=True):
+    stem = os.path.splitext(os.path.basename(path))[0]
+    rank = int(stem.split('_')[1])
+    with np.load(path) as z:
+      out[rank] = {k: z[k] for k in z.files}
+  if not out:
+    raise FileNotFoundError(f'no lens_<rank>.npz files under {in_dir}')
+  return out
+
+
+def validate(data, bin_size):
+  """Run the three invariant checks; returns (ok, report dict)."""
+  ranks = sorted(data)
+  failures = []
+
+  # 1. cross-rank same-bin-per-iteration: the padded length is a pure
+  # function of the drawn bin, so it must match across ranks elementwise.
+  ref = data[ranks[0]]['padded_lens']
+  for r in ranks[1:]:
+    other = data[r]['padded_lens']
+    if other.shape != ref.shape:
+      failures.append(f'rank {r}: padded_lens shape {other.shape} != '
+                      f'rank {ranks[0]} shape {ref.shape}')
+      continue
+    bad = np.nonzero(other != ref)
+    if bad[0].size:
+      e, i = bad[0][0], bad[1][0]
+      failures.append(
+          f'rank {r} disagrees with rank {ranks[0]} on the bin at '
+          f'epoch={e} iter={i}: padded {other[e, i]} vs {ref[e, i]} '
+          f'({bad[0].size} total disagreements)')
+
+  # 2. per-batch tightness: max-min <= bin_size, max <= padded.
+  worst_diff = 0
+  for r in ranks:
+    d = data[r]
+    diff = d['max_lens'].astype(np.int64) - d['min_lens'].astype(np.int64)
+    worst_diff = max(worst_diff, int(diff.max(initial=0)))
+    if bin_size is not None and (diff > bin_size).any():
+      e, i = np.argwhere(diff > bin_size)[0]
+      failures.append(
+          f'rank {r}: batch at epoch={e} iter={i} spans '
+          f'{diff[e, i]} > bin_size {bin_size} '
+          f'(min={d["min_lens"][e, i]}, max={d["max_lens"][e, i]})')
+    over = d['max_lens'] > d['padded_lens']
+    if over.any():
+      e, i = np.argwhere(over)[0]
+      failures.append(
+          f'rank {r}: real length exceeds padded length at '
+          f'epoch={e} iter={i} ({d["max_lens"][e, i]} > '
+          f'{d["padded_lens"][e, i]})')
+
+  # 3. padding waste from the aggregated histograms.
+  def hist_token_sum(h):
+    return int((np.arange(h.shape[0], dtype=np.uint64) * h).sum())
+
+  seq_hist = sum(
+      (np.pad(d['seq_len_hist'],
+              (0, max(len(x['seq_len_hist']) for x in data.values()) -
+               len(d['seq_len_hist'])))
+       for d in data.values()))
+  pad_hist = sum(
+      (np.pad(d['padded_zero_hist'],
+              (0, max(len(x['padded_zero_hist']) for x in data.values()) -
+               len(d['padded_zero_hist'])))
+       for d in data.values()))
+  real_tokens = hist_token_sum(seq_hist)
+  padded_zeros = hist_token_sum(pad_hist)
+
+  report = {
+      'ranks': len(ranks),
+      'iterations': int(ref.size),
+      'cross_rank_bin_agreement': not any('disagrees' in f or
+                                          'shape' in f for f in failures),
+      'worst_batch_spread': worst_diff,
+      'bin_size': bin_size,
+      'real_tokens': real_tokens,
+      'padded_zeros': padded_zeros,
+      'padding_waste_ratio': round(padded_zeros / max(real_tokens, 1), 4),
+      'failures': failures,
+  }
+  return not failures, report
+
+
+def plot(data, out_dir, bin_size, seq_hist_bin=32):
+  """Render the reference's five figures (best-effort; requires
+  matplotlib)."""
+  import matplotlib
+  matplotlib.use('Agg')
+  import matplotlib.pyplot as plt
+  os.makedirs(out_dir, exist_ok=True)
+  ranks = sorted(data)
+
+  # rank vs per-batch spread
+  fig, ax = plt.subplots()
+  for r in ranks:
+    d = data[r]
+    diff = (d['max_lens'].astype(np.int64) -
+            d['min_lens'].astype(np.int64)).ravel()
+    ax.scatter(np.full(diff.shape, r), diff, s=0.5)
+  ax.set_xlabel('rank')
+  ax.set_ylabel('max-min per batch')
+  ax.set_title('per-rank batch spread')
+  fig.savefig(os.path.join(out_dir, 'rank_diff.png'))
+  plt.close(fig)
+
+  # min vs max scatter per rank
+  for r in ranks:
+    d = data[r]
+    fig, ax = plt.subplots()
+    ax.scatter(d['min_lens'].ravel(), d['max_lens'].ravel(), s=0.5)
+    ax.set_xlabel('min len')
+    ax.set_ylabel('max len')
+    ax.set_title(f'rank {r} min vs max')
+    fig.savefig(os.path.join(out_dir, f'min_max_lens_{r}.png'))
+    plt.close(fig)
+
+  # global (cross-rank) spread per iteration
+  gmin = np.min([data[r]['min_lens'] for r in ranks], axis=0)
+  gmax = np.max([data[r]['max_lens'] for r in ranks], axis=0)
+  fig, ax = plt.subplots()
+  ax.plot((gmax.astype(np.int64) - gmin.astype(np.int64)).ravel())
+  ax.set_xlabel('iteration')
+  ax.set_ylabel('global max-min')
+  ax.set_title('cross-rank spread')
+  fig.savefig(os.path.join(out_dir, 'global_diff.png'))
+  plt.close(fig)
+
+  # histograms
+  for key, fname, xlabel in (
+      ('seq_len_hist', 'seq_len_hist.png', 'sequence length'),
+      ('padded_zero_hist', 'padded_zero_hist.png', 'padded zeros')):
+    width = max(len(data[r][key]) for r in ranks)
+    hist = sum(np.pad(data[r][key], (0, width - len(data[r][key])))
+               for r in ranks)
+    agg = [hist[s:s + seq_hist_bin].sum()
+           for s in range(0, width, seq_hist_bin)]
+    fig, ax = plt.subplots(figsize=(14, 4))
+    ax.bar(range(len(agg)), agg)
+    ax.set_xticks(range(len(agg)))
+    ax.set_xticklabels(
+        [f'{s}-{s + seq_hist_bin - 1}'
+         for s in range(0, width, seq_hist_bin)],
+        rotation=45, fontsize=6)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel('samples')
+    fig.tight_layout()
+    fig.savefig(os.path.join(out_dir, fname))
+    plt.close(fig)
+
+
+def main(argv=None):
+  p = argparse.ArgumentParser(
+      description=__doc__,
+      formatter_class=argparse.RawDescriptionHelpFormatter)
+  p.add_argument('--in-dir', required=True,
+                 help='directory holding lens_<rank>.npz dumps')
+  p.add_argument('--bin-size', type=int, default=None,
+                 help='expected bin width; enables the tightness check')
+  p.add_argument('--out-dir', default=None,
+                 help='write plots here (requires matplotlib)')
+  p.add_argument('--seq-len-hist-bin', type=int, default=32)
+  args = p.parse_args(argv)
+
+  data = collect(args.in_dir)
+  ok, report = validate(data, args.bin_size)
+  for f in report['failures']:
+    print(f'FAIL: {f}', file=sys.stderr)
+  print(f"ranks={report['ranks']} iterations={report['iterations']} "
+        f"worst_batch_spread={report['worst_batch_spread']} "
+        f"padding_waste={report['padding_waste_ratio']:.4f} "
+        f"({report['padded_zeros']} zeros / {report['real_tokens']} tokens)")
+  print(json.dumps(report))
+  if args.out_dir:
+    try:
+      plot(data, args.out_dir, args.bin_size, args.seq_len_hist_bin)
+      print(f'plots written to {args.out_dir}')
+    except ImportError:
+      print('matplotlib unavailable; skipping plots', file=sys.stderr)
+  return 0 if ok else 1
+
+
+if __name__ == '__main__':
+  sys.exit(main())

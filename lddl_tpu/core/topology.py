@@ -38,13 +38,25 @@ def discover_topology(comm=None):
     return Topology(rank=rank, world_size=1, local_rank=0, node_rank=0,
                     nproc_per_node=1)
   env_local = os.environ.get('LDDL_LOCAL_RANK', os.environ.get('LOCAL_RANK'))
-  if env_local is not None:
-    local_rank = int(env_local)
-    nproc_per_node = max(comm.allgather_object(local_rank)) + 1
+  # One collective carrying both candidate sources, so every rank runs the
+  # same collective sequence and the env-vs-hostname decision is made on
+  # world-consistent data (a launcher that sets LOCAL_RANK on only some
+  # ranks must not split the world into mismatched collectives).
+  gathered = comm.allgather_object(
+      (None if env_local is None else int(env_local), socket.gethostname()))
+  env_of_rank = [g[0] for g in gathered]
+  if all(e is not None for e in env_of_rank):
+    local_rank = env_of_rank[rank]
+    nproc_per_node = max(env_of_rank) + 1
     return Topology(rank=rank, world_size=world, local_rank=local_rank,
                     node_rank=rank // nproc_per_node,
                     nproc_per_node=nproc_per_node)
-  host_of_rank = comm.allgather_object(socket.gethostname())
+  if any(e is not None for e in env_of_rank):
+    import warnings
+    warnings.warn(
+        'LOCAL_RANK/LDDL_LOCAL_RANK set on some ranks but not all; '
+        'ignoring it and deriving topology from hostnames')
+  host_of_rank = [g[1] for g in gathered]
   node_of_host, members = {}, collections.defaultdict(list)
   for r, host in enumerate(host_of_rank):
     if host not in node_of_host:

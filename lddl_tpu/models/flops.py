@@ -1,0 +1,70 @@
+"""Analytic FLOP accounting and peak-throughput lookup for MFU reporting.
+
+The reference's mock training harness reports samples/s and latency only
+(``/root/reference/benchmarks/torch_train.py:188-199``); on TPU the number
+that actually tells you whether the input pipeline keeps the MXU busy is
+**model FLOPs utilization** = model FLOPs per second / peak chip FLOPs.
+This module provides the two ingredients:
+
+  - :func:`bert_pretrain_flops_per_step` — analytic matmul FLOPs of one
+    BERT MLM+NSP train step over a padded ``[batch, seq]`` batch (standard
+    transformer accounting: 24·B·S·d² + 4·B·S²·d per layer forward, MLM
+    head 2·B·S·d·(d+V), backward = 2× forward);
+  - :func:`peak_flops_per_device` — best-known bf16 peak for the running
+    chip generation (override with the harness's ``--peak-tflops`` when
+    the table is stale or the platform is unknown).
+"""
+
+import jax
+
+# Published bf16 peak TFLOP/s per chip, keyed by a lowercase substring of
+# jax's device_kind. Order matters: first match wins.
+_PEAK_TFLOPS_BF16 = (
+    ('v6e', 918.0),
+    ('trillium', 918.0),
+    ('v5p', 459.0),
+    ('v5 lite', 197.0),
+    ('v5e', 197.0),
+    ('v4', 275.0),
+    ('v3', 123.0),
+    ('v2', 45.0),
+)
+
+
+def peak_flops_per_device(device=None):
+  """Peak bf16 FLOP/s of ``device`` (default: jax.devices()[0]), or None
+  when the chip generation is not in the table (e.g. the CPU backend)."""
+  device = device or jax.devices()[0]
+  kind = device.device_kind.lower()
+  for key, tflops in _PEAK_TFLOPS_BF16:
+    if key in kind:
+      return tflops * 1e12
+  return None
+
+
+def bert_encoder_flops(cfg, batch, seq_len):
+  """Forward matmul FLOPs of the encoder stack on a padded batch.
+
+  Per layer: QKV+output projections 8·B·S·d², attention scores + context
+  (QKᵀ and PV) 4·B·S²·d, MLP in+out 4·B·S·d·d_ff. A multiply-add counts
+  as 2 FLOPs. Padded positions are counted — the MXU computes them.
+  """
+  b, s, d = batch, seq_len, cfg.hidden_size
+  per_layer = (8 * b * s * d * d + 4 * b * s * s * d +
+               4 * b * s * d * cfg.intermediate_size)
+  return cfg.num_layers * per_layer
+
+
+def bert_pretrain_flops_per_step(cfg, batch, seq_len):
+  """Total matmul FLOPs of one pretraining train step (fwd + bwd).
+
+  Head terms: MLM transform d², tied decoder d·V over every position,
+  pooler+NSP ≈ 2·B·d². Backward pass costs 2× forward; optimizer update
+  FLOPs are vector ops, negligible next to the matmuls.
+  """
+  b, s, d = batch, seq_len, cfg.hidden_size
+  fwd = bert_encoder_flops(cfg, batch, seq_len)
+  fwd += 2 * b * s * d * d                    # MLM transform
+  fwd += 2 * b * s * d * cfg.vocab_size       # tied decoder
+  fwd += 2 * b * d * d                        # pooler (NSP head is d x 2)
+  return 3 * fwd

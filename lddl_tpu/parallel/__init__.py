@@ -8,7 +8,13 @@ a ``jax.sharding.Mesh`` with data / fsdp / tensor / sequence axes, XLA
 collectives over ICI, and ring attention for long-context scaling.
 """
 
-from .mesh import MESH_AXES, batch_pspec, canonical_batch_spec, make_mesh
+from .mesh import (MESH_AXES, batch_pspec, canonical_batch_spec, make_mesh,
+                   mesh_summary)
 from .ring import ring_attention
+from .train import init_params, make_train_step, shard_batch
 
-__all__ = ['MESH_AXES', 'batch_pspec', 'canonical_batch_spec', 'make_mesh', 'ring_attention']
+__all__ = [
+    'MESH_AXES', 'batch_pspec', 'canonical_batch_spec', 'make_mesh',
+    'mesh_summary', 'ring_attention', 'init_params', 'make_train_step',
+    'shard_batch'
+]

@@ -148,15 +148,15 @@ class _StubComm:
     return len(self._hosts)
 
   def allgather_object(self, obj):
-    import socket
-    if obj == socket.gethostname():
-      return list(self._hosts)
-    # env-local_rank path gathers ints: synthesize ranks-within-host order.
-    out = []
-    seen = {}
+    # topology gathers one (env_local_or_None, hostname) tuple per rank;
+    # synthesize env local ranks as position-within-host when the caller
+    # has one set, else None everywhere.
+    env, _host = obj
+    out, seen = [], {}
     for h in self._hosts:
-      out.append(seen.setdefault(h, [0, 0])[0])
+      pos = seen.setdefault(h, [0])[0]
       seen[h][0] += 1
+      out.append((None if env is None else pos, h))
     return out
 
 
