@@ -13,8 +13,10 @@ reference ``lddl/dask/bert/pretrain.py:77-97,182-238``) run on the same
 corpus in the same process, so the ratio isolates the framework's
 pipeline improvements from hardware differences.
 
-Corpus size: LDDL_BENCH_MB (default 4). Baseline runs on a slice of the
-corpus and is scaled, bounded by LDDL_BENCH_BASELINE_MB (default 1).
+Corpus size: LDDL_BENCH_MB (default 16 — large enough that one-time
+process costs amortize as they do on a real multi-GB run). Baseline runs
+on a slice of the corpus and is scaled, bounded by LDDL_BENCH_BASELINE_MB
+(default 1).
 """
 
 import json
@@ -96,7 +98,7 @@ def _reference_style_partition(lines, hf_tok, vocab_words, seed):
 
 
 def main():
-  corpus_mb = float(os.environ.get('LDDL_BENCH_MB', '4'))
+  corpus_mb = float(os.environ.get('LDDL_BENCH_MB', '16'))
   baseline_mb = float(os.environ.get('LDDL_BENCH_BASELINE_MB', '1'))
   work = tempfile.mkdtemp(prefix='lddl_bench_')
   try:
@@ -130,6 +132,10 @@ def main():
     # the device-link probe, and the jit masking kernel compile.
     from lddl_tpu.preprocess.bert import _get_tokenizer
     from lddl_tpu.ops import mask_partition_device, resolve_mask_backend
+    try:  # pyarrow lazily imports pandas (when present) on first table
+      import pandas  # noqa: F401
+    except ImportError:
+      pass
     tok = _get_tokenizer(cfg)
     tok.batch_tokenize(['warm up'])
     if resolve_mask_backend(cfg.mask_backend) == 'device':

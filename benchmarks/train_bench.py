@@ -290,11 +290,13 @@ def run(args):
           debug_print(batch, tokenizer)
 
     # An --iters-per-epoch cutoff can leave the loader generator short of
-    # its final yield, where it advances its epoch counter; advance it
-    # ourselves (exactly once) so the next epoch gets a fresh permutation
-    # and fresh Philox mask keys instead of replaying this one.
-    if loader.epoch == epoch_before:
-      loader.epoch = epoch_before + 1
+    # its final yield, where it advances its epoch counter. Quiesce the
+    # prefetch producer (close() joins it), then pin the epoch to exactly
+    # before+1 — an unconditional assignment, so it is correct whether or
+    # not the generator got to its own increment.
+    if train:
+      device_stream.close()
+    loader.epoch = epoch_before + 1
 
     epoch_elapsed = time.perf_counter() - epoch_start
     measured = max(meter.total, 1e-9)
@@ -304,8 +306,8 @@ def run(args):
         'iters': meter.iters,
         'epoch_seconds': round(epoch_elapsed, 3),
         'avg_latency_ms': round(meter.avg * 1e3, 3),
-        'min_latency_ms': round(meter.min * 1e3, 3),
-        'max_latency_ms': round(meter.max * 1e3, 3),
+        'min_latency_ms': round(meter.min * 1e3, 3) if meter.count else 0.0,
+        'max_latency_ms': round(meter.max * 1e3, 3) if meter.count else 0.0,
         'avg_data_wait_ms': round(data_meter.avg * 1e3, 3),
         'samples_per_sec': round(total_samples / measured, 2),
         'tokens_per_sec': round(total_tokens / measured, 1),

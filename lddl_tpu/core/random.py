@@ -50,6 +50,20 @@ def randrange(stop, rng_state=None):
   return n, _swap_rng_state(orig_rng_state)
 
 
+def randrange_batch(stop, k, rng_state=None):
+  """``k`` successive ``randrange(stop)`` draws with a single state swap.
+
+  Draw-for-draw identical to ``k`` :func:`randrange` calls — the state
+  tuple is only (de)materialized once instead of per draw, which matters
+  in per-line loops (the scatter shuffle draws one target per corpus
+  line).
+  """
+  orig_rng_state = _swap_rng_state(rng_state)
+  draw = _py_random.randrange
+  ns = [draw(stop) for _ in range(k)]
+  return ns, _swap_rng_state(orig_rng_state)
+
+
 def random(rng_state=None):
   orig_rng_state = _swap_rng_state(rng_state)
   x = _py_random.random()

@@ -13,6 +13,7 @@ TPU-first deltas:
     ``np.save``/``np.load`` buffers rather than pickle.
 """
 
+import functools
 import io
 import os
 
@@ -116,8 +117,12 @@ def count_parquet_samples_strided(paths, comm=None):
   return [int(c) for c in counts]
 
 
+@functools.lru_cache(maxsize=4096)
 def _npy_header(descr, n):
-  """The exact ``.npy`` v1.0 header ``np.save`` writes for a 1-D array."""
+  """The exact ``.npy`` v1.0 header ``np.save`` writes for a 1-D array.
+
+  Cached: static-masking serialization emits one header per sample and the
+  (descr, length) space is tiny next to the call count."""
   body = "{'descr': '%s', 'fortran_order': False, 'shape': (%d,), }" % (
       descr, n)
   pad = (-(10 + len(body) + 1)) % 64

@@ -102,6 +102,10 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
       yield item
   finally:
     stop.set()
+    # Serialize with the producer: after close() returns, the source
+    # iterator is guaranteed quiescent (it may be mid-pull right now, e.g.
+    # finishing an epoch and mutating loader state).
+    t.join()
 
 
 class SeqlenAwarePrefetcher:

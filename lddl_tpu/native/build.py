@@ -7,18 +7,26 @@ builders (many worker processes may race on first use).
 """
 
 import ctypes
+import glob
 import hashlib
 import os
 import subprocess
 import tempfile
 
-_SRC = os.path.join(os.path.dirname(__file__), 'src', 'wordpiece.cpp')
+_SRC_DIR = os.path.join(os.path.dirname(__file__), 'src')
 _LIB_CACHE = {}
 
 
+def _sources():
+  return sorted(glob.glob(os.path.join(_SRC_DIR, '*.cpp')))
+
+
 def _lib_path():
-  with open(_SRC, 'rb') as f:
-    digest = hashlib.sha256(f.read()).hexdigest()[:12]
+  h = hashlib.sha256()
+  for src in _sources():
+    with open(src, 'rb') as f:
+      h.update(f.read())
+  digest = h.hexdigest()[:12]
   return os.path.join(os.path.dirname(__file__), f'_lddl_native.{digest}.so')
 
 
@@ -38,7 +46,7 @@ def build_library(verbose=False):
       tmp_so = os.path.join(tmp, 'out.so')
       cmd = [
           'g++', '-O3', '-march=native', '-shared', '-fPIC', '-std=c++17',
-          '-pthread', '-o', tmp_so, _SRC
+          '-pthread', '-o', tmp_so, *_sources()
       ]
       if verbose:
         print('building native library:', ' '.join(cmd))
@@ -88,6 +96,12 @@ def load_library():
       c.c_char_p, c.c_int64, c.POINTER(c.c_int32)
   ]
   lib.lddl_native_abi_version.restype = c.c_int64
+  lib.lddl_plan_pairs.restype = c.c_int64
+  lib.lddl_plan_pairs.argtypes = [
+      c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,
+      c.POINTER(c.c_uint32), c.POINTER(c.c_int32), c.c_int32, c.c_double,
+      c.c_int32, c.POINTER(c.c_int64), c.c_int64
+  ]
   _LIB_CACHE[path] = lib
   return lib
 

@@ -63,6 +63,18 @@ def resolve_mask_backend(backend='auto'):
   return 'device' if _device_link_usable() else 'host'
 
 
+def ragged_indices(lengths):
+  """(row_idx, within_row_idx) index arrays for ragged row extraction."""
+  lengths = np.asarray(lengths, dtype=np.int64)
+  n = len(lengths)
+  total = int(lengths.sum())
+  starts = np.zeros(n, dtype=np.int64)
+  np.cumsum(lengths[:-1], out=starts[1:])
+  row_idx = np.repeat(np.arange(n, dtype=np.int64), lengths)
+  col_idx = np.arange(total, dtype=np.int64) - np.repeat(starts, lengths)
+  return row_idx, col_idx
+
+
 def assemble_pair_matrix(flat_ids, a_ranges, b_ranges, cls_id, sep_id,
                          max_len, pad_id=0):
   """Assemble ``[CLS] A [SEP] B [SEP]`` rows into a padded int32 matrix.
@@ -80,16 +92,17 @@ def assemble_pair_matrix(flat_ids, a_ranges, b_ranges, cls_id, sep_id,
     raise ValueError(f'pair of {row_len.max()} tokens exceeds max_len '
                      f'{max_len}')
   mat = np.full((n, max_len), pad_id, dtype=np.int32)
-  for i in range(n):
-    a0, a1 = a_ranges[i]
-    b0, b1 = b_ranges[i]
-    la = a1 - a0
-    lb = b1 - b0
-    mat[i, 0] = cls_id
-    mat[i, 1:1 + la] = flat_ids[a0:a1]
-    mat[i, 1 + la] = sep_id
-    mat[i, 2 + la:2 + la + lb] = flat_ids[b0:b1]
-    mat[i, 2 + la + lb] = sep_id
+  if n == 0:
+    return mat, row_len, na
+  rows = np.arange(n)
+  na64, nb64 = na.astype(np.int64), nb.astype(np.int64)
+  ra, ca = ragged_indices(na64)
+  mat[ra, ca + 1] = flat_ids[a_ranges[ra, 0] + ca]
+  rb, cb = ragged_indices(nb64)
+  mat[rb, cb + 2 + na64[rb]] = flat_ids[b_ranges[rb, 0] + cb]
+  mat[rows, 0] = cls_id
+  mat[rows, 1 + na64] = sep_id
+  mat[rows, row_len.astype(np.int64) - 1] = sep_id
   return mat, row_len, na
 
 
@@ -115,8 +128,11 @@ def mask_batch_host(ids_mat, row_len, na, *, masked_lm_ratio, vocab_size,
   if max_predictions is not None:
     k = np.minimum(k, max_predictions)
   k = np.minimum(k, valid.sum(axis=1))
-  # rank of each u within its row; the k smallest valid entries win
-  order = np.argsort(u, axis=1, kind='stable')
+  # rank of each u within its row; the k smallest valid entries win.
+  # Default (unstable) sort: ~2x faster than mergesort here, and equal
+  # float64 draws are measure-zero, so the selection is still a
+  # deterministic function of the Philox stream.
+  order = np.argsort(u, axis=1)
   ranks = np.empty_like(order)
   rows = np.arange(n)[:, None]
   ranks[rows, order] = np.arange(l)[None, :]

@@ -42,8 +42,10 @@ def scatter_partition(lines, src_index, num_targets, spill_dir, seed,
   """
   state = _scatter_state(seed, src_index)
   buckets = [[] for _ in range(num_targets)]
-  for line in lines:
-    j, state = lrandom.randrange(num_targets, rng_state=state)
+  lines = list(lines)
+  targets, state = lrandom.randrange_batch(num_targets, len(lines),
+                                           rng_state=state)
+  for line, j in zip(lines, targets):
     buckets[j].append(line)
   counts = []
   for j, bucket in enumerate(buckets):

@@ -285,17 +285,6 @@ def encode_documents(doc_texts, tokenizer, sentence_backend='rules',
   return TokenizedDocs(flat, sent_offsets, doc_counts[nonempty])
 
 
-def _ragged_indices(lengths):
-  """(row_idx, within_row_idx) index arrays for ragged row extraction."""
-  n = len(lengths)
-  total = int(lengths.sum())
-  starts = np.zeros(n, dtype=np.int64)
-  np.cumsum(lengths[:-1], out=starts[1:])
-  row_idx = np.repeat(np.arange(n, dtype=np.int64), lengths)
-  col_idx = np.arange(total, dtype=np.int64) - np.repeat(starts, lengths)
-  return row_idx, col_idx
-
-
 def _string_column(tokenizer, flat_ids, offsets):
   """Ragged id ranges -> Arrow string column of space-joined tokens
   (zero-copy from native buffers when available)."""
@@ -318,6 +307,7 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
   accelerator, and zero-copy Arrow column assembly.
   """
   from ..ops import assemble_pair_matrix, mask_batch
+  from ..ops import masking as _masking_ops
   from ..core.utils import serialize_u16_batch
   from .pairing import plan_pairs_partition
 
@@ -353,18 +343,18 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
         mat, row_len32, na32, masked_lm_ratio=cfg.masked_lm_ratio,
         vocab_size=tokenizer.vocab_size, mask_id=tokenizer.mask_token_id,
         seed=mask_seed, backend='host')
-    ra, ca = _ragged_indices(na)
+    ra, ca = _masking_ops.ragged_indices(na)
     flat_a = masked[ra, ca + 1]
-    rb, cb = _ragged_indices(nb)
+    rb, cb = _masking_ops.ragged_indices(nb)
     flat_b = masked[rb, cb + 2 + na[rb]]
     ri, ci = np.nonzero(picked)  # row-major -> positions sorted per row
     label_ids = mat[ri, ci].astype(np.int32)
     k = picked.sum(axis=1).astype(np.int64)
   else:
     # Ragged gather straight from the flat partition ids (no id matrix).
-    ra, ca = _ragged_indices(na)
+    ra, ca = _masking_ops.ragged_indices(na)
     flat_a = flat_ids[a_ranges[ra, 0] + ca]
-    rb, cb = _ragged_indices(nb)
+    rb, cb = _masking_ops.ragged_indices(nb)
     flat_b = flat_ids[b_ranges[rb, 0] + cb]
     if mask_mode == 'device':
       positions, new_ids, kk = mask_partition_device(

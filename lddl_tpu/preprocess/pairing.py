@@ -132,14 +132,48 @@ def plan_pairs_from_document(docs, document_index, rng, out,
     i += 1
 
 
+_NATIVE_PLANNER = None  # unresolved; False once probing failed
+
+
+def _native_planner():
+  """Resolve the native planner once per process; None when the native
+  toolchain is unavailable (first failure warns, then stays on Python)."""
+  global _NATIVE_PLANNER
+  if _NATIVE_PLANNER is None:
+    import os
+    if os.environ.get('LDDL_PAIRING') == 'python':
+      _NATIVE_PLANNER = False
+    else:
+      try:
+        from ..native.build import load_library
+        from ..native.pairing import plan_pairs_partition_native
+        load_library()  # g++ build happens here, inside the guard
+        _NATIVE_PLANNER = plan_pairs_partition_native
+      except Exception as e:  # no g++ / build failure
+        import warnings
+        warnings.warn(f'native pair planner unavailable ({e}); '
+                      'planning pairs in Python')
+        _NATIVE_PLANNER = False
+  return _NATIVE_PLANNER or None
+
+
 def plan_pairs_partition(docs, rng, max_seq_length=128, short_seq_prob=0.1,
-                         duplicate_factor=1):
+                         duplicate_factor=1, backend='auto'):
   """Plan all pairs of a partition (``duplicate_factor`` passes over all
   documents, like the slow path's outer loop).
 
   Returns (a_ranges int64 [n,2], b_ranges int64 [n,2], is_random_next
-  bool [n]).
+  bool [n]). ``backend='auto'`` uses the native planner when buildable
+  (bit-identical outputs and rng stream — ``src/pairing.cpp``; set env
+  ``LDDL_PAIRING=python`` to force the Python path); 'python' forces this
+  module's loop.
   """
+  if backend == 'auto':
+    native = _native_planner()
+    if native is not None:
+      return native(docs, rng, max_seq_length=max_seq_length,
+                    short_seq_prob=short_seq_prob,
+                    duplicate_factor=duplicate_factor)
   out = []
   for _ in range(duplicate_factor):
     for di in range(len(docs)):

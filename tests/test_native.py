@@ -164,3 +164,31 @@ class TestDecode:
     _, nat = hf_and_native
     with pytest.raises(TypeError):
       pickle.dumps(nat)
+
+
+def test_pairing_falls_back_without_toolchain(monkeypatch):
+  """A host without g++ must degrade to the Python planner with a warning,
+  not crash at first use (the build runs lazily inside the probe)."""
+  import warnings
+  import numpy as np
+  from lddl_tpu.preprocess import pairing
+  from lddl_tpu.native import build
+
+  def boom():
+    raise FileNotFoundError('g++')
+
+  monkeypatch.setattr(pairing, '_NATIVE_PLANNER', None)
+  monkeypatch.setattr(build, 'load_library', boom)
+  docs = pairing.TokenizedDocs(
+      np.arange(40, dtype=np.int32),
+      np.array([0, 10, 25, 40], dtype=np.int64), [2, 1])
+  import random
+  with warnings.catch_warnings(record=True) as w:
+    warnings.simplefilter('always')
+    a, b, ir = pairing.plan_pairs_partition(docs, random.Random(3),
+                                            backend='auto')
+  assert any('native pair planner unavailable' in str(x.message) for x in w)
+  a2, b2, ir2 = pairing.plan_pairs_partition(docs, random.Random(3),
+                                             backend='python')
+  assert np.array_equal(a, a2) and np.array_equal(b, b2)
+  monkeypatch.setattr(pairing, '_NATIVE_PLANNER', None)  # re-probe later
