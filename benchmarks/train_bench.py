@@ -181,6 +181,16 @@ def run(args):
   from lddl_tpu.loader import get_bert_pretrain_data_loader
   from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
 
+  if args.dp_world_size == 1:
+    # Multi-host pod run with defaults: each process feeds its own dp
+    # shard and dumps its own lens_<rank>.npz (the reference derives the
+    # same from the launcher env; torch_train.py:98-104). Applies to both
+    # modes — a loader-mode pod run otherwise duplicates data per host.
+    import jax
+    if jax.process_count() > 1:
+      args.dp_rank = jax.process_index()
+      args.dp_world_size = jax.process_count()
+
   tokenizer = load_bert_tokenizer(
       vocab_file=args.vocab_file, hub_name=args.tokenizer, backend='hf')
   loader = get_bert_pretrain_data_loader(

@@ -52,6 +52,11 @@ def preprocess_codebert_pretrain(args=None):
   main(args)
 
 
+def prepare_codesearchnet(args=None):
+  from .download.codesearchnet import main
+  main(args)
+
+
 def balance_shards(args=None):
   from .balance import main
   main(args)
@@ -70,6 +75,7 @@ _COMMANDS = {
     'preprocess_bert_pretrain': preprocess_bert_pretrain,
     'preprocess_bart_pretrain': preprocess_bart_pretrain,
     'preprocess_codebert_pretrain': preprocess_codebert_pretrain,
+    'prepare_codesearchnet': prepare_codesearchnet,
     'balance_shards': balance_shards,
     'balance_dask_output': balance_shards,  # reference-compatible alias
     'generate_num_samples_cache': generate_num_samples_cache,

@@ -32,6 +32,7 @@ setup(
             'preprocess_bart_pretrain=lddl_tpu.cli:preprocess_bart_pretrain',
             'preprocess_codebert_pretrain='
             'lddl_tpu.cli:preprocess_codebert_pretrain',
+            'prepare_codesearchnet=lddl_tpu.cli:prepare_codesearchnet',
             'balance_shards=lddl_tpu.cli:balance_shards',
             'generate_num_samples_cache='
             'lddl_tpu.cli:generate_num_samples_cache',
