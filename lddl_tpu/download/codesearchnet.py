@@ -128,9 +128,11 @@ def shard_data(extracted_pkl, out_dir, num_blocks=4096, seed=12345):
       for item in zip(ids, docs, codes)
   ]
   perm = np.random.default_rng(seed).permutation(len(records))
-  block_size = -(-len(records) // num_blocks)  # ceil: no empty tail blocks
   for b in range(num_blocks):
-    chunk = perm[b * block_size:(b + 1) * block_size]
+    # Round-robin over the permutation: block sizes differ by at most one
+    # (contiguous ceil-chunking leaves empty tail blocks whenever the
+    # count is not a multiple of num_blocks).
+    chunk = perm[b::num_blocks]
     with open(os.path.join(out_dir, f'block_{b}.txt'), 'w',
               encoding='utf-8', newline='') as f:
       for idx in chunk:

@@ -14,13 +14,18 @@ import os
 import threading
 
 from ..core import attach_bool_arg
-from .utils import shard_documents
+
 
 
 class ArticleSink:
-  """Thread-safe streaming writer: news-please invokes the callback from
-  many threads; each thread appends to its own spool file (the reference
-  uses the same thread-local layout, ``common_crawl.py:310-352``)."""
+  """Thread- and process-safe streaming writer: news-please invokes the
+  callback from many threads (and, with ``number_of_extraction_processes
+  > 1``, from forked worker processes); each (process, thread) appends to
+  its own spool file (the reference uses a thread-local layout,
+  ``common_crawl.py:310-352``, which silently loses worker-process
+  buffers — here a forked child detects the pid change, drops the
+  buffers it inherited (the parent owns flushing those), namespaces its
+  spool files and doc ids by pid, and registers its own exit flush)."""
 
   def __init__(self, spool_dir, articles_per_flush=512):
     self._dir = spool_dir
@@ -30,13 +35,35 @@ class ArticleSink:
     self._count = 0
     self._lock = threading.Lock()
     self._all_buffers = []  # [(buf, path)] so a final flush sees every thread
+    self._pid = os.getpid()
+    self._register_exit_flush()
+
+  def _register_exit_flush(self):
+    import atexit
+    atexit.register(self.flush)
+    # multiprocessing children skip atexit (they leave via
+    # util._exit_function), but that path does run Finalize callbacks with
+    # an exitpriority — needed because news-please's extraction workers
+    # are multiprocessing processes.
+    import multiprocessing.util as mp_util
+    mp_util.Finalize(self, type(self).flush, args=(self,), exitpriority=10)
+
+  def _check_fork(self):
+    pid = os.getpid()
+    if pid != self._pid:
+      self._pid = pid
+      self._all_buffers = []
+      self._count = 0
+      self._local = threading.local()
+      self._lock = threading.Lock()
+      self._register_exit_flush()
 
   def _thread_buffer(self):
     buf = getattr(self._local, 'buf', None)
     if buf is None:
       self._local.buf = buf = []
       self._local.path = os.path.join(
-          self._dir, f'articles-{threading.get_ident()}.txt')
+          self._dir, f'articles-{self._pid}-{threading.get_ident()}.txt')
       with self._lock:
         self._all_buffers.append((buf, self._local.path))
     return buf
@@ -46,12 +73,13 @@ class ArticleSink:
     title = getattr(article, 'title', '') or ''
     if not text:
       return
+    self._check_fork()
     buf = self._thread_buffer()
     with self._lock:
       self._count += 1
       idx = self._count
     one_line = ' '.join((title + ' ' + text).split())
-    buf.append(f'ccnews-{idx} {one_line}\n')
+    buf.append(f'ccnews-{self._pid}-{idx} {one_line}\n')
     if len(buf) >= self._per_flush:
       self._write(buf, self._local.path)
 
@@ -70,7 +98,15 @@ class ArticleSink:
 
 
 def crawl(spool_dir, start_date, end_date, languages=('en',),
-          articles_per_flush=512):
+          articles_per_flush=512, valid_hosts=None, warc_dir=None,
+          strict_date=True, reuse_previously_downloaded_files=True,
+          continue_after_error=True, show_download_progress=False,
+          delete_warc_after_extraction=True, continue_process=True,
+          number_of_extraction_processes=1):
+  """Crawl CC-NEWS WARCs into the spool (reference
+  ``common_crawl.py:452-483``): host filters, WARC reuse/idempotence, and
+  crash resume (``continue_process`` restarts extraction from the last
+  fully downloaded but unextracted WARC) all forward to news-please."""
   try:
     from newsplease.crawler import commoncrawl_crawler
   except ImportError:
@@ -80,22 +116,47 @@ def crawl(spool_dir, start_date, end_date, languages=('en',),
   sink = ArticleSink(spool_dir, articles_per_flush)
   commoncrawl_crawler.crawl_from_commoncrawl(
       sink,
-      valid_hosts=None,
+      valid_hosts=valid_hosts,
       start_date=start_date,
       end_date=end_date,
       language=list(languages),
+      strict_date=strict_date,
+      reuse_previously_downloaded_files=reuse_previously_downloaded_files,
+      local_download_dir_warc=warc_dir,
+      continue_after_error=continue_after_error,
+      show_download_progress=show_download_progress,
+      number_of_extraction_processes=number_of_extraction_processes,
+      delete_warc_after_extraction=delete_warc_after_extraction,
+      continue_process=continue_process,
+      fetch_images=False,
   )
   sink.flush()
+
+
+def _read_one_spool(path):
+  """Yield (doc_id, text) out of one spool file (top-level so the parallel
+  sharder can pickle it)."""
+  with open(path, encoding='utf-8') as f:
+    for line in f:
+      parts = line.split(None, 1)
+      if len(parts) == 2:
+        yield parts[0], parts[1]
 
 
 def read_spools(spool_dir):
   """Yield (doc_id, text) back out of the spool files."""
   for p in sorted(glob.glob(os.path.join(spool_dir, 'articles-*.txt'))):
-    with open(p, encoding='utf-8') as f:
-      for line in f:
-        parts = line.split(None, 1)
-        if len(parts) == 2:
-          yield parts[0], parts[1]
+    yield from _read_one_spool(p)
+
+
+def shard_spools(spool_dir, outdir, num_shards, num_workers=None):
+  """Aggregate spool files into shards, one worker per output shard (the
+  reference aggregates with a process pool too, ``common_crawl.py:425-426``)."""
+  from .utils import shard_text_files_parallel
+  paths = sorted(glob.glob(os.path.join(spool_dir, 'articles-*.txt')))
+  return shard_text_files_parallel(paths, outdir, num_shards,
+                                   _read_one_spool,
+                                   num_workers=num_workers)
 
 
 def attach_args(parser):
@@ -104,9 +165,37 @@ def attach_args(parser):
   parser.add_argument('--end-date', type=str, default='2020-02-01')
   parser.add_argument('--langs', type=str, default='en',
                       help='comma-separated language codes')
+  parser.add_argument('--valid-hosts', type=str, nargs='*', default=None,
+                      help='keep only articles from these hosts '
+                           '(reference common_crawl.py:216-226)')
   parser.add_argument('--num-shards', type=int, default=256)
+  parser.add_argument('--num-workers', type=int, default=None,
+                      help='processes for shard aggregation '
+                           '(default: all cores)')
+  parser.add_argument('--articles-per-write', type=int, default=512)
+  parser.add_argument('--number-of-extraction-processes', type=int,
+                      default=1)
   attach_bool_arg(parser, 'crawl', default=True)
   attach_bool_arg(parser, 'shard', default=True)
+  attach_bool_arg(
+      parser, 'strict-date', default=True,
+      help_str='discard articles whose publish date falls outside '
+               '[start-date, end-date]')
+  attach_bool_arg(
+      parser, 'reuse-previously-downloaded-files', default=True,
+      help_str='skip WARCs already present in <outdir>/warc (no integrity '
+               'check, same caveat as the reference)')
+  attach_bool_arg(
+      parser, 'continue-after-error', default=True,
+      help_str='keep crawling when news-please hits an error')
+  attach_bool_arg(parser, 'show-download-progress', default=False)
+  attach_bool_arg(
+      parser, 'delete-warc-after-extraction', default=True,
+      help_str='delete each WARC once its articles are extracted')
+  attach_bool_arg(
+      parser, 'continue-process', default=True,
+      help_str='resume extraction from fully-downloaded but unextracted '
+               'WARCs of a previous run (filters must be unchanged)')
   return parser
 
 
@@ -121,9 +210,21 @@ def main(args=None):
         spool,
         datetime.datetime.fromisoformat(args.start_date),
         datetime.datetime.fromisoformat(args.end_date),
-        languages=args.langs.split(','))
+        languages=args.langs.split(','),
+        articles_per_flush=args.articles_per_write,
+        valid_hosts=args.valid_hosts,
+        warc_dir=os.path.join(outdir, 'warc'),
+        strict_date=args.strict_date,
+        reuse_previously_downloaded_files=(
+            args.reuse_previously_downloaded_files),
+        continue_after_error=args.continue_after_error,
+        show_download_progress=args.show_download_progress,
+        delete_warc_after_extraction=args.delete_warc_after_extraction,
+        continue_process=args.continue_process,
+        number_of_extraction_processes=args.number_of_extraction_processes)
   if args.shard:
-    counts = shard_documents(read_spools(spool), source, args.num_shards)
+    counts = shard_spools(spool, source, args.num_shards,
+                          num_workers=args.num_workers)
     print(f'sharded {sum(counts)} articles into {len(counts)} shards '
           f'under {source}')
 

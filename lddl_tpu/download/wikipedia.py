@@ -17,7 +17,7 @@ import subprocess
 import sys
 
 from ..core import attach_bool_arg
-from .utils import download_file, shard_documents
+from .utils import download_file
 
 _URLS = {
     'en': 'https://dumps.wikimedia.org/enwiki/latest/'
@@ -71,11 +71,17 @@ def extract_dump(dump_path, extract_dir, shard_size='128M'):
       check=True)
 
 
-def shard_extracted(extract_dir, outdir, num_shards):
+def shard_extracted(extract_dir, outdir, num_shards, num_workers=None):
+  """Parse + shard the wikiextractor output, one worker process per output
+  shard (the reference shards via a multiprocessing.Pool too,
+  ``wikipedia.py:84-85``; round 1 here was serial — a real bottleneck on a
+  full dump)."""
+  from .utils import shard_text_files_parallel
   paths = sorted(glob.glob(os.path.join(extract_dir, '**', 'wiki_*'),
                            recursive=True))
-  docs = (doc for p in paths for doc in parse_extracted_shard(p))
-  return shard_documents(docs, outdir, num_shards)
+  return shard_text_files_parallel(paths, outdir, num_shards,
+                                   parse_extracted_shard,
+                                   num_workers=num_workers)
 
 
 def attach_args(parser):
@@ -85,6 +91,8 @@ def attach_args(parser):
   parser.add_argument('--num-shards', type=int, default=256)
   parser.add_argument('--shard-size', type=str, default='128M',
                       help='wikiextractor shard size')
+  parser.add_argument('--num-workers', type=int, default=None,
+                      help='processes for shard prep (default: all cores)')
   attach_bool_arg(parser, 'download', default=True)
   attach_bool_arg(parser, 'extract', default=True)
   attach_bool_arg(parser, 'shard', default=True)
@@ -103,7 +111,8 @@ def main(args=None):
   if args.extract:
     extract_dump(dump, extract_dir, shard_size=args.shard_size)
   if args.shard:
-    counts = shard_extracted(extract_dir, source, args.num_shards)
+    counts = shard_extracted(extract_dir, source, args.num_shards,
+                             num_workers=args.num_workers)
     print(f'sharded {sum(counts)} articles into {len(counts)} shards '
           f'under {source}')
 

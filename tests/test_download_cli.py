@@ -96,3 +96,97 @@ class TestCli:
         '--sample-ratio', '1.0',
     ])
     assert any(f.endswith('.parquet') for f in os.listdir(sink))
+
+
+class TestParallelSharding:
+
+  def _make_extracts(self, tmp_path, n_files=5, docs_per_file=4):
+    d = tmp_path / 'extracted'
+    os.makedirs(d)
+    for i in range(n_files):
+      blocks = []
+      for j in range(docs_per_file):
+        blocks.append(f'<doc id="{i}-{j}" url="u" title="T">\nT\n'
+                      f'body of doc {i} {j}.\n</doc>\n')
+      (d / f'wiki_{i:02d}').write_text(''.join(blocks))
+    return str(d)
+
+  def test_wikipedia_parallel_shard(self, tmp_path):
+    from lddl_tpu.download.wikipedia import shard_extracted
+    extract = self._make_extracts(tmp_path)
+    serial = str(tmp_path / 'serial')
+    parallel = str(tmp_path / 'parallel')
+    c1 = shard_extracted(extract, serial, 3, num_workers=1)
+    c2 = shard_extracted(extract, parallel, 3, num_workers=3)
+    assert c1 == c2
+    assert sum(c1) == 20
+    for j in range(3):
+      a = open(os.path.join(serial, f'{j}.txt')).read()
+      b = open(os.path.join(parallel, f'{j}.txt')).read()
+      assert a == b  # worker-count independent output
+    # strided file->shard assignment: shard 0 holds files 0 and 3
+    first = open(os.path.join(serial, '0.txt')).read().splitlines()
+    assert first[0].startswith('wiki-0-0 ') and first[4].startswith('wiki-3-0 ')
+
+  def test_common_crawl_parallel_spool_shard(self, tmp_path):
+    from lddl_tpu.download.common_crawl import shard_spools
+    spool = tmp_path / 'spool'
+    os.makedirs(spool)
+    for t in range(4):
+      with open(spool / f'articles-{t}.txt', 'w') as f:
+        for k in range(3):
+          f.write(f'ccnews-{t}-{k} article text {t} {k}\n')
+    counts = shard_spools(str(spool), str(tmp_path / 'src'), 2,
+                          num_workers=2)
+    assert sum(counts) == 12
+    lines = open(tmp_path / 'src' / '0.txt').read().splitlines()
+    assert all(l.startswith('ccnews-') for l in lines)
+
+  def test_empty_tail_shards_still_written(self, tmp_path):
+    from lddl_tpu.download.utils import shard_text_files_parallel
+    from lddl_tpu.download.common_crawl import _read_one_spool
+    p = tmp_path / 'articles-0.txt'
+    p.write_text('id-0 text\n')
+    counts = shard_text_files_parallel([str(p)], str(tmp_path / 'out'), 3,
+                                       _read_one_spool, num_workers=1)
+    assert counts == [1, 0, 0]
+    assert sorted(os.listdir(tmp_path / 'out')) == ['0.txt', '1.txt', '2.txt']
+
+
+def test_article_sink_process_safe(tmp_path):
+  """Forked extraction workers (--number-of-extraction-processes > 1) must
+  not collide spool files / doc ids with the parent, and must flush their
+  own tails at exit."""
+  import multiprocessing
+  spool = str(tmp_path / 'spool')
+  sink = ArticleSink(spool, articles_per_flush=100)  # > n: exit flush only
+  sink(types.SimpleNamespace(maintext='parent text', title='P'))
+
+  def child(k):
+    for i in range(3):
+      sink(types.SimpleNamespace(maintext=f'child {k} {i}', title='C'))
+    # rely on the child's atexit flush — no explicit flush here
+
+  ctx = multiprocessing.get_context('fork')
+  procs = [ctx.Process(target=child, args=(k,)) for k in range(2)]
+  for p in procs:
+    p.start()
+  for p in procs:
+    p.join()
+  assert all(p.exitcode == 0 for p in procs)
+  sink.flush()
+  docs = list(read_spools(spool))
+  assert len(docs) == 7  # 1 parent + 2x3 children, none lost or duplicated
+  assert len({d[0] for d in docs}) == 7  # pid-namespaced unique ids
+
+
+def test_codesearchnet_shard_non_multiple(tmp_path):
+  import pickle
+  from lddl_tpu.download.codesearchnet import shard_data
+  with open(tmp_path / 'extracted.pkl', 'wb') as f:
+    pickle.dump(([f'i{k}' for k in range(5)], [''] * 5, ['x'] * 5), f)
+  src = shard_data(str(tmp_path / 'extracted.pkl'), str(tmp_path / 'src'),
+                   num_blocks=4, seed=1)
+  sizes = [os.path.getsize(os.path.join(src, b))
+           for b in sorted(os.listdir(src))]
+  assert len(sizes) == 4 and all(s > 0 for s in sizes)  # 2,1,1,1 split
