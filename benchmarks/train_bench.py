@@ -161,7 +161,8 @@ def build_train_state(args, tokenizer):
       num_layers=layers,
       num_heads=heads,
       intermediate_size=inter,
-      max_position_embeddings=max(args.max_seq_length, 512))
+      max_position_embeddings=max(args.max_seq_length, 512),
+      remat=args.remat)
   model = BertForPretraining(cfg)
   mesh = make_mesh(data=args.dp, fsdp=args.fsdp, tensor=args.tp,
                    seq=args.sp)
@@ -387,6 +388,9 @@ def attach_args(parser):
   parser.add_argument('--prefetch', type=int, default=2)
   parser.add_argument('--peak-tflops', type=float, default=None,
                       help='override per-chip peak bf16 TFLOP/s for MFU')
+  parser.add_argument('--remat', action='store_true',
+                      help='rematerialize layer activations (trade FLOPs '
+                           'for HBM; lets bigger batches fit)')
   return parser
 
 

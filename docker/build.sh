@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Build the lddl_tpu TPU-VM image (reference analogue: docker/build.sh).
+set -euo pipefail
+cd "$(dirname "${BASH_SOURCE[0]}")/.."
+docker build -f docker/tpu.Dockerfile -t lddl_tpu .

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Interactive/one-shot runner for the lddl_tpu image on a TPU-VM host
+# (reference analogue: docker/interactive.sh, which wires --gpus; TPU
+# containers need the TPU character devices + host networking instead).
+#
+# Usage: bash docker/interactive.sh [extra-mounts] [cmd] [image]
+
+MOUNTS=${1:-""}
+CMD=${2:-"bash"}
+IMAGE=${3:-"lddl_tpu"}
+
+docker run \
+  --privileged \
+  --init \
+  -it \
+  --rm \
+  --network=host \
+  --ipc=host \
+  -e TPU_NAME -e TPU_WORKER_ID -e TPU_WORKER_HOSTNAMES \
+  -v "$PWD":/workspace/lddl_tpu \
+  ${MOUNTS} \
+  "${IMAGE}" \
+  ${CMD}

@@ -1,0 +1,39 @@
+# lddl_tpu image for TPU-VM hosts.
+#
+# TPU-native analogue of the reference's NGC images
+# (docker/ngc_pyt.Dockerfile, ngc_paddle.Dockerfile): instead of an NGC
+# CUDA base, start from a slim Python base and install the TPU-enabled
+# jax wheels. On a TPU-VM the container must run with --privileged (or
+# the TPU device flags) and host networking so libtpu can reach the
+# chips; see docker/interactive.sh.
+#
+# Build:  docker build -f docker/tpu.Dockerfile -t lddl_tpu .
+
+FROM python:3.12-slim-bookworm
+
+ENV LANG=C.UTF-8 \
+    LC_ALL=C.UTF-8 \
+    PIP_NO_CACHE_DIR=1
+
+RUN apt-get update -qq && \
+    apt-get install -y --no-install-recommends \
+        git vim tmux g++ make libjemalloc-dev wget && \
+    rm -rf /var/lib/apt/lists/*
+
+# TPU-enabled jax + the framework's Python dependencies.
+RUN pip install -U pip && \
+    pip install "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html && \
+    pip install flax optax orbax-checkpoint chex einops \
+        numpy pyarrow transformers requests tqdm pytest
+
+# The preprocessor is malloc-heavy on the host side; jemalloc is the same
+# allocator swap the reference documents (README.md:22-28).
+ENV LD_PRELOAD=/usr/lib/x86_64-linux-gnu/libjemalloc.so.2
+
+WORKDIR /workspace/lddl_tpu
+ADD . .
+RUN pip install ./
+
+# Pre-build the native WordPiece/pairing library so first use in the
+# container does not need the toolchain race.
+RUN python -c "from lddl_tpu.native.build import build_library; build_library(verbose=True)"

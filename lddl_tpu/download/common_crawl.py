@@ -36,6 +36,11 @@ class ArticleSink:
     self._lock = threading.Lock()
     self._all_buffers = []  # [(buf, path)] so a final flush sees every thread
     self._pid = os.getpid()
+    # Never replaced after construction (unlike _lock, which a post-fork
+    # reset swaps), so it can safely serialize the reset itself. The
+    # parent only ever acquires it here in __init__-time registration
+    # paths, so it cannot be held across a fork.
+    self._reset_lock = threading.Lock()
     self._register_exit_flush()
 
   def _register_exit_flush(self):
@@ -49,14 +54,22 @@ class ArticleSink:
     mp_util.Finalize(self, type(self).flush, args=(self,), exitpriority=10)
 
   def _check_fork(self):
-    pid = os.getpid()
-    if pid != self._pid:
-      self._pid = pid
+    if os.getpid() == self._pid:
+      return
+    # Double-checked under a lock that is itself never swapped: two
+    # threads making the child's first callbacks concurrently must not
+    # both run the reset (the loser would discard the winner's
+    # freshly-registered buffer, losing its articles).
+    with self._reset_lock:
+      pid = os.getpid()
+      if pid == self._pid:
+        return
       self._all_buffers = []
       self._count = 0
       self._local = threading.local()
       self._lock = threading.Lock()
       self._register_exit_flush()
+      self._pid = pid  # last: gates the unsynchronized fast path
 
   def _thread_buffer(self):
     buf = getattr(self._local, 'buf', None)

@@ -44,6 +44,17 @@ def _sanitize_one_line(text):
   return ' '.join(text.split())
 
 
+def _write_doc_line(f, doc_id, text):
+  """Write one document under the one-doc-per-line contract; returns
+  whether anything was written (empty docs are dropped). The single copy
+  of the contract — every sharding path goes through here."""
+  line = _sanitize_one_line(text)
+  if not line:
+    return False
+  f.write(f'{doc_id} {line}\n')
+  return True
+
+
 def shard_documents(docs, outdir, num_shards):
   """Round-robin (doc_id, text) documents into ``num_shards`` txt shards.
 
@@ -59,81 +70,122 @@ def shard_documents(docs, outdir, num_shards):
   try:
     i = 0
     for doc_id, text in docs:
-      line = _sanitize_one_line(text)
-      if not line:
-        continue
-      files[i % num_shards].write(f'{doc_id} {line}\n')
-      counts[i % num_shards] += 1
-      i += 1
+      if _write_doc_line(files[i % num_shards], doc_id, text):
+        counts[i % num_shards] += 1
+        i += 1
   finally:
     for f in files:
       f.close()
   return counts
 
 
-def _shard_worker(task):
-  """Parse this shard's input files and write its .txt output (one
-  (sub)process per output shard)."""
-  shard_idx, input_paths, out_path, parse_fn = task
-  count = 0
+def _scatter_worker(task):
+  """Phase A: parse one input file, round-robin its docs into per-(file,
+  shard) spill files. Returns per-shard counts for this file."""
+  file_idx, path, num_shards, spill_dir, parse_fn = task
+  counts = [0] * num_shards
+  writers = {}
+  try:
+    k = 0
+    for doc_id, text in parse_fn(path):
+      # Stagger each file's starting shard so short files don't all pile
+      # onto the low shard indices.
+      j = (file_idx + k) % num_shards
+      f = writers.get(j)
+      if f is None:
+        writers[j] = f = open(
+            os.path.join(spill_dir, f'shard{j}.src{file_idx}'), 'w',
+            encoding='utf-8')
+      if _write_doc_line(f, doc_id, text):
+        counts[j] += 1
+        k += 1
+  finally:
+    for f in writers.values():
+      f.close()
+  return file_idx, counts
+
+
+def _concat_worker(task):
+  """Phase B: concatenate one shard's spill files (sorted source order)."""
+  shard_idx, spill_paths, out_path = task
   tmp = out_path + '.tmp'
-  with open(tmp, 'w', encoding='utf-8') as f:
-    for path in input_paths:
-      for doc_id, text in parse_fn(path):
-        line = _sanitize_one_line(text)
-        if line:
-          f.write(f'{doc_id} {line}\n')
-          count += 1
+  with open(tmp, 'wb') as out:
+    for p in spill_paths:
+      with open(p, 'rb') as f:
+        while True:
+          chunk = f.read(1 << 22)
+          if not chunk:
+            break
+          out.write(chunk)
   os.replace(tmp, out_path)
-  return shard_idx, count
+  return shard_idx
+
+
+def _run_pool(worker, tasks, num_workers):
+  """map ``worker`` over ``tasks``, in-process when num_workers <= 1 else
+  via a jax-safe multiprocessing pool; yields results."""
+  import multiprocessing
+  if num_workers <= 1 or len(tasks) <= 1:
+    yield from map(worker, tasks)
+    return
+  from ..pipeline.executor import _default_mp_context
+  ctx = _default_mp_context() or multiprocessing
+  pool = ctx.Pool(min(num_workers, len(tasks)))
+  try:
+    yield from pool.imap_unordered(worker, tasks)
+    pool.close()
+    pool.join()
+  except BaseException:
+    pool.terminate()
+    raise
 
 
 def shard_text_files_parallel(input_paths, outdir, num_shards, parse_fn,
                               num_workers=None):
-  """Parallel shard preparation: output shard ``j`` is the parse of input
-  files ``input_paths[j::num_shards]``, written by its own worker process.
+  """Parallel shard preparation with document-level balance.
 
-  The reference parallelizes shard prep the same way — a
-  ``multiprocessing.Pool`` with a 1:1 input-file -> output-shard mapping
-  (``lddl/download/wikipedia.py:84-85``, ``common_crawl.py:425-426``);
-  here the file->shard assignment is strided so ``num_shards`` is a free
-  choice. File-level granularity means balance matches the reference's
-  (whole input files per shard); when there are fewer input files than
-  requested shards that would leave empty shards, so the helper falls
-  back to the serial per-document round-robin of :func:`shard_documents`
-  instead. Deterministic either way: the assignment depends only on
-  sorted input order, never on worker count. ``parse_fn(path)`` must be a
-  picklable top-level function yielding ``(doc_id, text)``. Returns
-  per-shard document counts.
+  The reference parallelizes shard prep with a ``multiprocessing.Pool``
+  and a 1:1 input-file -> output-shard mapping
+  (``lddl/download/wikipedia.py:84-85``, ``common_crawl.py:425-426``) —
+  which couples shard count and balance to the input file layout. Here
+  prep is a two-phase scatter/concat: workers parse input files in
+  parallel, round-robining each file's documents into per-(file, shard)
+  spill files, then workers concatenate each shard's spills in sorted
+  source order. Shard contents are a pure function of the sorted input
+  paths — independent of worker count — and documents spread evenly over
+  all ``num_shards`` even when there are fewer input files than shards.
+  ``parse_fn(path)`` must be a picklable top-level function yielding
+  ``(doc_id, text)``. Returns per-shard document counts.
   """
-  import multiprocessing
+  import shutil
+  import tempfile
 
   os.makedirs(outdir, exist_ok=True)
   input_paths = sorted(input_paths)
-  if len(input_paths) < num_shards:
-    docs = (doc for p in input_paths for doc in parse_fn(p))
-    return shard_documents(docs, outdir, num_shards)
-  tasks = [
-      (j, input_paths[j::num_shards], os.path.join(outdir, f'{j}.txt'),
-       parse_fn) for j in range(num_shards)
-  ]
   if num_workers is None:
     num_workers = max(1, os.cpu_count() or 1)
-  num_workers = min(num_workers, num_shards)
   counts = [0] * num_shards
-  if num_workers <= 1:
-    for j, c in map(_shard_worker, tasks):
-      counts[j] = c
-    return counts
-  from ..pipeline.executor import _default_mp_context
-  ctx = _default_mp_context() or multiprocessing
-  pool = ctx.Pool(num_workers)
+  spill_dir = tempfile.mkdtemp(prefix='.shard_spill_', dir=outdir)
   try:
-    for j, c in pool.imap_unordered(_shard_worker, tasks):
-      counts[j] = c
-    pool.close()
-    pool.join()
-    return counts
-  except BaseException:
-    pool.terminate()
-    raise
+    scatter = [(i, p, num_shards, spill_dir, parse_fn)
+               for i, p in enumerate(input_paths)]
+    per_file = {}
+    for file_idx, file_counts in _run_pool(_scatter_worker, scatter,
+                                           num_workers):
+      per_file[file_idx] = file_counts
+    for file_counts in per_file.values():
+      for j, c in enumerate(file_counts):
+        counts[j] += c
+    concat = []
+    for j in range(num_shards):
+      spills = [
+          os.path.join(spill_dir, f'shard{j}.src{i}')
+          for i in range(len(input_paths))
+          if per_file.get(i, [0] * num_shards)[j]
+      ]
+      concat.append((j, spills, os.path.join(outdir, f'{j}.txt')))
+    for _ in _run_pool(_concat_worker, concat, num_workers):
+      pass
+  finally:
+    shutil.rmtree(spill_dir, ignore_errors=True)
+  return counts

@@ -124,9 +124,16 @@ class TestParallelSharding:
       a = open(os.path.join(serial, f'{j}.txt')).read()
       b = open(os.path.join(parallel, f'{j}.txt')).read()
       assert a == b  # worker-count independent output
-    # strided file->shard assignment: shard 0 holds files 0 and 3
+    # per-file round-robin with a per-file stagger, concatenated in sorted
+    # file order: file 0 starts at shard 0 (docs 0, 3), file 1 at shard 1
+    # (its doc 2 lands on shard 0)
     first = open(os.path.join(serial, '0.txt')).read().splitlines()
-    assert first[0].startswith('wiki-0-0 ') and first[4].startswith('wiki-3-0 ')
+    assert first[0].startswith('wiki-0-0 ') and first[1].startswith('wiki-0-3 ')
+    assert first[2].startswith('wiki-1-2 ')
+    # docs spread over all shards even with fewer files than shards
+    spread = shard_extracted(extract, str(tmp_path / 'spread'), 8,
+                             num_workers=2)
+    assert all(c > 0 for c in spread)
 
   def test_common_crawl_parallel_spool_shard(self, tmp_path):
     from lddl_tpu.download.common_crawl import shard_spools
