@@ -31,9 +31,11 @@ RUN pip install -U pip && \
 ENV LD_PRELOAD=/usr/lib/x86_64-linux-gnu/libjemalloc.so.2
 
 WORKDIR /workspace/lddl_tpu
-ADD . .
+COPY . .
 RUN pip install ./
 
-# Pre-build the native WordPiece/pairing library so first use in the
-# container does not need the toolchain race.
-RUN python -c "from lddl_tpu.native.build import build_library; build_library(verbose=True)"
+# Pre-build the native WordPiece/pairing library into the *installed*
+# copy (cd / so the import resolves to site-packages, not the source tree
+# that docker/interactive.sh bind-mounts over). Runs using the mounted
+# source tree still rebuild lazily on first use — g++ is in the image.
+RUN cd / && python -c "from lddl_tpu.native.build import build_library; build_library(verbose=True)"

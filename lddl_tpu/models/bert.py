@@ -11,7 +11,9 @@ next_sentence_labels). Design choices for the MXU/XLA:
   - static shapes everywhere — the loader's per-bin padding means one
     compiled program per bin;
   - attention is pluggable: 'dense' (XLA fuses the softmax chain; GSPMD
-    inserts collectives if heads/seq are sharded) or 'ring'
+    inserts collectives if heads/seq are sharded), 'flash' (Pallas
+    blockwise-softmax kernel, :mod:`lddl_tpu.ops.flash_attention` — no
+    O(s^2) score materialization), or 'ring'
     (:mod:`lddl_tpu.parallel.ring`) for sequence-parallel long context;
   - tied MLM decoder (logits against the word-embedding table), vocab
     sharded over the ``tensor`` axis.
@@ -42,7 +44,7 @@ class BertConfig:
   type_vocab_size: int = 2
   dropout_rate: float = 0.1
   dtype: Any = jnp.bfloat16
-  attention_impl: str = 'dense'  # 'dense' | 'ring'
+  attention_impl: str = 'dense'  # 'dense' | 'flash' | 'ring' | 'ring_flash'
   remat: bool = False
 
   @property
@@ -75,9 +77,20 @@ class SelfAttention(nn.Module):
     q = q.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
-    if cfg.attention_impl == 'ring' and self.mesh is not None:
+    if (cfg.attention_impl in ('ring', 'ring_flash') and
+        self.mesh is not None):
       from ..parallel.ring import make_ring_attention
-      ctx = make_ring_attention(self.mesh)(q, k, v, attention_mask)
+      block_impl = 'flash' if cfg.attention_impl == 'ring_flash' else 'dense'
+      ctx = make_ring_attention(self.mesh, block_impl=block_impl)(
+          q, k, v, attention_mask)
+    elif cfg.attention_impl in ('flash', 'ring_flash'):
+      # ring_flash without a mesh degenerates to single-chip flash.
+      from ..ops.flash_attention import (flash_attention,
+                                         make_flash_attention)
+      if self.mesh is not None:
+        ctx = make_flash_attention(self.mesh)(q, k, v, attention_mask)
+      else:
+        ctx = flash_attention(q, k, v, attention_mask)
     else:
       scale = 1.0 / (hd ** 0.5)
       scores = jnp.einsum(

@@ -1,0 +1,308 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+The attention score matrix is the one O(s^2) memory object in BERT-style
+training; XLA materializes it per layer (``models/bert.py`` dense path).
+This kernel never does: softmax runs online over key blocks with a
+running (max, sum, accumulator) in VMEM, so per-core attention memory is
+O(block^2) regardless of sequence length, and the backward pass
+recomputes probabilities blockwise from the saved log-sum-exp instead of
+storing them.
+
+Layout: inputs ``[batch, heads, seq, head_dim]`` are flattened to
+``[batch*heads, seq, head_dim]``; the grid walks (batch*heads, q-blocks)
+for forward/dq and (batch*heads, k-blocks) for dk/dv, with full per-head
+K/V resident in VMEM (fine through multi-k sequences: 2048 x 64 x 4B =
+512 KB/head-operand) and 128-wide blocks feeding the MXU.
+
+Masking: a key-side additive bias ``[batch, seq]`` (0 = attend, -1e9 =
+padding) — the same semantics as the dense path and the ring
+(:mod:`lddl_tpu.parallel.ring`) path. Ring composes with this kernel
+(``ring_attention(block_impl='flash')`` /
+``BertConfig(attention_impl='ring_flash')``): ring shards the sequence
+across chips and rotates K/V, each chip's local block runs here via
+:func:`flash_attention_with_lse`, and the (out, lse) pair enters ring's
+streaming-softmax merge exactly.
+
+Differentiation is a ``jax.custom_vjp``: forward saves (out, lse); the
+backward runs two Pallas kernels — dq over q-blocks, (dk, dv) over
+k-blocks — each recomputing P = exp(s - lse) blockwise.
+
+Off TPU the kernels run in Pallas interpret mode, so the CPU test suite
+exercises the identical code path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e9
+
+
+def _interpret():
+  return jax.devices()[0].platform != 'tpu'
+
+
+def _padded_len(s):
+  """Kernel sequence length: a multiple of the block size so every
+  ``pl.ds`` slice is in bounds (pallas clamps out-of-bounds dynamic
+  slices, which would silently shift tail-block data instead of
+  erroring). The wrapper pads inputs to this length — padded key columns
+  carry a -inf bias, padded query rows are sliced away."""
+  if s <= 128:
+    return ((s + 7) // 8) * 8  # sublane-tile multiple
+  return ((s + 127) // 128) * 128
+
+
+def _block_sizes(s):
+  return min(128, s), min(128, s)
+
+
+def _col_bias(bias_ref, j0, width):
+  return bias_ref[0, 0, pl.ds(j0, width)].astype(jnp.float32)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, s_kv,
+                scale, block_k):
+  q = q_ref[0].astype(jnp.float32)  # [bq, d]
+  bq, d = q.shape
+  m = jnp.full((bq, 1), NEG_INF, jnp.float32)
+  l = jnp.zeros((bq, 1), jnp.float32)
+  acc = jnp.zeros((bq, d), jnp.float32)
+  for j in range(pl.cdiv(s_kv, block_k)):
+    j0 = j * block_k
+    k_blk = k_ref[0, pl.ds(j0, block_k), :].astype(jnp.float32)
+    v_blk = v_ref[0, pl.ds(j0, block_k), :].astype(jnp.float32)
+    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    scores = scores + _col_bias(bias_ref, j0, block_k)[None, :]
+    m_blk = jnp.max(scores, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(scores - m_new)
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+    m = m_new
+  o_ref[0] = (acc / l).astype(o_ref.dtype)
+  lse_ref[0] = m + jnp.log(l)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, *, s_kv, scale, block_k):
+  q = q_ref[0].astype(jnp.float32)
+  do = do_ref[0].astype(jnp.float32)
+  lse = lse_ref[0]      # [bq, 1]
+  delta = delta_ref[0]  # [bq, 1]
+  dq = jnp.zeros_like(q)
+  for j in range(pl.cdiv(s_kv, block_k)):
+    j0 = j * block_k
+    k_blk = k_ref[0, pl.ds(j0, block_k), :].astype(jnp.float32)
+    v_blk = v_ref[0, pl.ds(j0, block_k), :].astype(jnp.float32)
+    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    scores = scores + _col_bias(bias_ref, j0, block_k)[None, :]
+    p = jnp.exp(scores - lse)
+    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq = dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
+  dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, s_q, scale, block_q):
+  k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
+  v_blk = v_ref[0].astype(jnp.float32)
+  bk, d = k_blk.shape
+  j0 = pl.program_id(1) * bk
+  bias = _col_bias(bias_ref, j0, bk)[None, :]
+  dk = jnp.zeros((bk, d), jnp.float32)
+  dv = jnp.zeros((bk, d), jnp.float32)
+  for i in range(pl.cdiv(s_q, block_q)):
+    i0 = i * block_q
+    q = q_ref[0, pl.ds(i0, block_q), :].astype(jnp.float32)
+    do = do_ref[0, pl.ds(i0, block_q), :].astype(jnp.float32)
+    lse = lse_ref[0, pl.ds(i0, block_q), :]
+    delta = delta_ref[0, pl.ds(i0, block_q), :]
+    # Rows beyond the real sequence carry lse from padded-q garbage; their
+    # dO is zero (cotangents of padding outputs are never produced by the
+    # loss) so they contribute nothing — but guard exp() overflow anyway.
+    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    scores = scores + bias
+    p = jnp.exp(jnp.minimum(scores - lse, 30.0))
+    dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+  dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+  dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _specs(s_q, s_kv, d, heads, block_q):
+  """(blocked q-side spec, full kv-side spec, bias spec) for grid
+  (bh, q-blocks).
+
+  Layout note: TPU lowering requires each block's last two dims to be
+  (multiple-of-8, multiple-of-128) or equal to the array dims, so scalar
+  rows ride as trailing-singleton 3-D arrays — bias ``[b, 1, s_kv]``,
+  lse/delta ``[bh, s_q, 1]``."""
+  blocked = pl.BlockSpec((1, block_q, d), lambda i, b: (i, b, 0))
+  full = pl.BlockSpec((1, s_kv, d), lambda i, b: (i, 0, 0))
+  bias = pl.BlockSpec((1, 1, s_kv), lambda i, b: (i // heads, 0, 0))
+  return blocked, full, bias
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash_pair(q, k, v, bias, heads):
+  """(out, lse) with gradients defined for both outputs — lse cotangents
+  arise when results of separate flash calls are merged downstream (the
+  ring composition's streaming-softmax combine)."""
+  return _flash_fwd_impl(q, k, v, bias, heads)
+
+
+def _flash_fwd_impl(q, k, v, bias, heads):
+  bh, s_q, d = q.shape
+  s_kv = k.shape[1]
+  block_q, _ = _block_sizes(s_q)
+  _, block_k = _block_sizes(s_kv)
+  grid = (bh, pl.cdiv(s_q, block_q))
+  q_spec, full_spec, bias_spec = _specs(s_q, s_kv, d, heads, block_q)
+  out, lse = pl.pallas_call(
+      functools.partial(_fwd_kernel, s_kv=s_kv, scale=1.0 / d**0.5,
+                        block_k=block_k),
+      grid=grid,
+      in_specs=[q_spec, full_spec, full_spec, bias_spec],
+      out_specs=[
+          pl.BlockSpec((1, block_q, d), lambda i, b: (i, b, 0)),
+          pl.BlockSpec((1, block_q, 1), lambda i, b: (i, b, 0)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+          jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32),
+      ],
+      interpret=_interpret(),
+  )(q, k, v, bias)
+  return out, lse
+
+
+def _flash_fwd(q, k, v, bias, heads):
+  out, lse = _flash_fwd_impl(q, k, v, bias, heads)
+  return (out, lse), (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(heads, res, cotangents):
+  q, k, v, bias, out, lse = res
+  g, g_lse = cotangents
+  bh, s_q, d = q.shape
+  s_kv = k.shape[1]
+  block_q, _ = _block_sizes(s_q)
+  _, block_k = _block_sizes(s_kv)
+  g = g.astype(q.dtype)
+  # d(out)/dS = P(delta-terms); d(lse)/dS = P — so an lse cotangent folds
+  # into the shared (dp - delta) factor as delta -= g_lse.
+  delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                  axis=-1, keepdims=True)  # [bh, s, 1]
+  delta = delta - g_lse.astype(jnp.float32)
+  scale = 1.0 / d**0.5
+  q_spec, full_spec, bias_spec = _specs(s_q, s_kv, d, heads, block_q)
+  q_full = pl.BlockSpec((1, s_q, d), lambda i, b: (i, 0, 0))
+  row_blocked = pl.BlockSpec((1, block_q, 1), lambda i, b: (i, b, 0))
+  row_full = pl.BlockSpec((1, s_q, 1), lambda i, b: (i, 0, 0))
+
+  dq = pl.pallas_call(
+      functools.partial(_dq_kernel, s_kv=s_kv, scale=scale, block_k=block_k),
+      grid=(bh, pl.cdiv(s_q, block_q)),
+      in_specs=[q_spec, full_spec, full_spec, bias_spec, q_spec,
+                row_blocked, row_blocked],
+      out_specs=pl.BlockSpec((1, block_q, d), lambda i, b: (i, b, 0)),
+      out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+      interpret=_interpret(),
+  )(q, k, v, bias, g, lse, delta)
+
+  k_spec = pl.BlockSpec((1, block_k, d), lambda i, b: (i, b, 0))
+  dk, dv = pl.pallas_call(
+      functools.partial(_dkv_kernel, s_q=s_q, scale=scale, block_q=block_q),
+      grid=(bh, pl.cdiv(s_kv, block_k)),
+      in_specs=[q_full, k_spec, k_spec, bias_spec, q_full,
+                row_full, row_full],
+      out_specs=[k_spec, k_spec],
+      out_shape=[
+          jax.ShapeDtypeStruct((bh, s_kv, d), q.dtype),
+          jax.ShapeDtypeStruct((bh, s_kv, d), q.dtype),
+      ],
+      interpret=_interpret(),
+  )(q, k, v, bias, g, lse, delta)
+  return dq, dk, dv, jnp.zeros_like(bias)
+
+
+_flash_pair.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention_with_lse(q, k, v, attention_mask=None):
+  """Like :func:`flash_attention` but also returns the per-query
+  log-sum-exp ``[batch, heads, seq]`` (float32) — the quantity needed to
+  exactly merge attention results computed over disjoint key sets (ring
+  attention's streaming-softmax combine). Gradients flow through both
+  outputs.
+  """
+  b, h, s_q, d = q.shape
+  s_kv = k.shape[2]
+  if attention_mask is None:
+    bias = jnp.zeros((b, s_kv), jnp.float32)
+  else:
+    bias = jnp.where(attention_mask != 0, 0.0, NEG_INF).astype(jnp.float32)
+  bias = bias[:, None, :]  # [b, 1, s_kv]: TPU block-tiling-friendly layout
+  sq_pad, skv_pad = _padded_len(s_q), _padded_len(s_kv)
+  if sq_pad != s_q:
+    q = jnp.pad(q, ((0, 0), (0, 0), (0, sq_pad - s_q), (0, 0)))
+  if skv_pad != s_kv:
+    kv_pad = ((0, 0), (0, 0), (0, skv_pad - s_kv), (0, 0))
+    k = jnp.pad(k, kv_pad)
+    v = jnp.pad(v, kv_pad)
+    bias = jnp.pad(bias, ((0, 0), (0, 0), (0, skv_pad - s_kv)),
+                   constant_values=NEG_INF)
+  out, lse = _flash_pair(q.reshape(b * h, sq_pad, d),
+                         k.reshape(b * h, skv_pad, d),
+                         v.reshape(b * h, skv_pad, d), bias, h)
+  out = out.reshape(b, h, sq_pad, d)[:, :, :s_q, :]
+  lse = lse.reshape(b, h, sq_pad)[:, :, :s_q]
+  return out, lse
+
+
+def flash_attention(q, k, v, attention_mask=None):
+  """Blockwise-softmax attention; drop-in for the dense einsum path.
+
+  ``q, k, v``: ``[batch, heads, seq, head_dim]``; ``attention_mask``:
+  ``[batch, seq]`` with 1 = attend, 0 = padding (key side). Returns the
+  context ``[batch, heads, seq, head_dim]`` in the input dtype.
+  """
+  return flash_attention_with_lse(q, k, v, attention_mask)[0]
+
+
+def make_flash_attention(mesh, q_spec=None, mask_spec=None):
+  """Wrap :func:`flash_attention` in ``shard_map`` for jitted use over a
+  mesh: batch over (data, fsdp), heads over tensor — a ``pallas_call``
+  has no GSPMD partitioning rule, so without this the compiler would
+  replicate q/k/v onto every chip. The sequence axis must be unsharded
+  (flash is per-chip block math; sequence sharding is ring attention's
+  job — use ``attention_impl='ring_flash'`` for both).
+  """
+  from jax.sharding import PartitionSpec as P
+  if dict(zip(mesh.axis_names, mesh.devices.shape)).get('seq', 1) > 1:
+    raise ValueError(
+        "flash attention does not shard the sequence axis; use "
+        "attention_impl='ring_flash' on meshes with seq > 1")
+  names = set(mesh.axis_names)
+  batch_axes = tuple(a for a in ('data', 'fsdp') if a in names) or None
+  head_axis = 'tensor' if 'tensor' in names else None
+  q_spec = q_spec or P(batch_axes, head_axis, None, None)
+  mask_spec = mask_spec or P(batch_axes, None)
+
+  @functools.partial(
+      jax.shard_map,
+      mesh=mesh,
+      in_specs=(q_spec, q_spec, q_spec, mask_spec),
+      out_specs=q_spec,
+      check_vma=False)
+  def _sharded(q, k, v, mask):
+    return flash_attention(q, k, v, mask)
+
+  return _sharded

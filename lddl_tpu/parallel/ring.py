@@ -36,12 +36,20 @@ def _block_attn(q, k, v, bias, scale):
   return m, o, jnp.sum(p, axis=-1, keepdims=True)
 
 
-def ring_attention(q, k, v, kv_mask=None, axis_name='seq'):
+def ring_attention(q, k, v, kv_mask=None, axis_name='seq',
+                   block_impl='dense'):
   """Exact softmax attention with K/V sharded along ``axis_name``.
 
   Shapes (per-device shards): q,k,v ``[b, h, s_block, d]``; ``kv_mask``
   ``[b, s_block]`` with 1 = attend, 0 = padding (it rotates with K/V).
   Must run inside ``shard_map`` with ``axis_name`` bound.
+
+  ``block_impl``: the per-chip block-attention kernel — 'dense' (einsum;
+  materializes the per-shard score matrix) or 'flash'
+  (:func:`lddl_tpu.ops.flash_attention.flash_attention_with_lse`; the
+  flash (out, lse) pair enters the streaming-softmax merge as
+  ``(m=lse, o=out, l=1)``, keeping per-chip attention memory O(block^2)
+  on top of ring's cross-chip O(s/N) sharding).
   """
   n = lax.axis_size(axis_name)
   scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -53,13 +61,28 @@ def ring_attention(q, k, v, kv_mask=None, axis_name='seq'):
       return None
     return jnp.where(mask, 0.0, neg)[:, None, None, :].astype(jnp.float32)
 
+  if block_impl == 'flash':
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    def block(k_blk, v_blk, mask_blk):
+      out, lse = flash_attention_with_lse(q, k_blk, v_blk, mask_blk)
+      # Flash output is already normalized by its own denominator:
+      # (m=lse, o=out, l=1) merges exactly — exp(lse - M) * out carries
+      # the true exp(m - M) * unnormalized sum.
+      lse = lse[..., None]
+      return lse, out.astype(jnp.float32), jnp.ones_like(lse)
+  elif block_impl == 'dense':
+    def block(k_blk, v_blk, mask_blk):
+      return _block_attn(qf, k_blk, v_blk, bias_of(mask_blk), scale)
+  else:
+    raise ValueError(f'unknown block_impl {block_impl!r}')
+
   perm = [(i, (i + 1) % n) for i in range(n)]
 
   def body(i, carry):
     del i
     k_blk, v_blk, mask_blk, m_acc, o_acc, l_acc = carry
-    m_blk, o_blk, l_blk = _block_attn(qf, k_blk, v_blk, bias_of(mask_blk),
-                                      scale)
+    m_blk, o_blk, l_blk = block(k_blk, v_blk, mask_blk)
     m_new = jnp.maximum(m_acc, m_blk)
     alpha = jnp.exp(m_acc - m_new)
     beta = jnp.exp(m_blk - m_new)
@@ -84,11 +107,14 @@ def ring_attention(q, k, v, kv_mask=None, axis_name='seq'):
   return (o_acc / jnp.maximum(l_acc, 1e-20)).astype(q.dtype)
 
 
-def make_ring_attention(mesh, q_spec=None, mask_spec=None, axis_name='seq'):
+def make_ring_attention(mesh, q_spec=None, mask_spec=None, axis_name='seq',
+                        block_impl='dense'):
   """Wrap :func:`ring_attention` in ``shard_map`` for use from jitted code.
 
   ``q_spec`` defaults to ``P(('data','fsdp'), 'tensor', 'seq', None)`` —
   batch over dp, heads over tensor parallelism, sequence over the ring.
+  ``block_impl='flash'`` runs each chip's block attention as the Pallas
+  flash kernel.
   """
   q_spec = q_spec or P(('data', 'fsdp'), 'tensor', axis_name, None)
   mask_spec = mask_spec or P(('data', 'fsdp'), axis_name)
@@ -100,6 +126,7 @@ def make_ring_attention(mesh, q_spec=None, mask_spec=None, axis_name='seq'):
       out_specs=q_spec,
       check_vma=False)
   def _sharded(q, k, v, kv_mask):
-    return ring_attention(q, k, v, kv_mask, axis_name=axis_name)
+    return ring_attention(q, k, v, kv_mask, axis_name=axis_name,
+                          block_impl=block_impl)
 
   return _sharded
