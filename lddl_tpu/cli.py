@@ -57,6 +57,11 @@ def prepare_codesearchnet(args=None):
   main(args)
 
 
+def pretrain_bert(args=None):
+  from .training.pretrain import main
+  main(args)
+
+
 def balance_shards(args=None):
   from .balance import main
   main(args)
@@ -76,6 +81,7 @@ _COMMANDS = {
     'preprocess_bart_pretrain': preprocess_bart_pretrain,
     'preprocess_codebert_pretrain': preprocess_codebert_pretrain,
     'prepare_codesearchnet': prepare_codesearchnet,
+    'pretrain_bert': pretrain_bert,
     'balance_shards': balance_shards,
     'balance_dask_output': balance_shards,  # reference-compatible alias
     'generate_num_samples_cache': generate_num_samples_cache,

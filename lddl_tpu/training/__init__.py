@@ -1,0 +1,9 @@
+"""Training loop layer: the consumer the reference always assumed.
+
+LDDL is a data library; its README points users at external NVIDIA BERT
+trainers. Here the trainer is in-repo so the full contract — preprocess
+-> balance -> load -> sharded train step -> checkpoint/resume — is owned,
+tested, and deterministic end to end.
+"""
+
+from .pretrain import TrainLoop, main  # noqa: F401

@@ -1,0 +1,349 @@
+"""BERT pretraining loop: mesh-sharded steps + checkpoint/resume.
+
+The reference delegates training to external consumers and supports their
+checkpoints only through ``start_epoch``/``samples_seen`` loader replay
+(``lddl/torch_mp/bert.py:426-456``). Here the trainer is part of the
+framework and the two halves are tied together: a checkpoint stores the
+sharded model/optimizer state *and* the global ``samples_seen`` counter,
+so a restart resumes both the parameter trajectory and the data stream
+position. Resume determinism matches the reference's contract exactly:
+every restart from the same checkpoint continues identically (bin draws
+replay, dynamic-mask Philox keys are (seed, epoch, rank, step)-keyed, the
+epoch's sample set is preserved); the shuffle buffer restarts fresh after
+the skip (reference ``torch_mp/datasets.py:87-98``), so within-bin sample
+*order* may differ from the never-interrupted trajectory.
+
+Checkpointing uses orbax with sharding-aware restore: each host writes
+its shards, restore places leaves directly onto the mesh.
+
+CLI: ``python -m lddl_tpu.cli pretrain_bert --path <balanced> ...``.
+"""
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import time
+
+
+def _place_opt_state(opt_state, params, mesh):
+  """Give every optimizer-state leaf an explicit mesh placement.
+
+  Adam's ``mu``/``nu`` mirror the params tree, so each leaf inherits the
+  sharding of the params leaf whose tree path it ends with (longest
+  suffix wins); everything else (step counters, schedule scalars) is
+  replicated. Without this the layout is whatever jit happened to pick —
+  fine for one run, but a checkpoint restore reproduces it faithfully
+  and then conflicts with the mesh-sharded params inside the jitted
+  step.
+  """
+  import jax
+  from jax.sharding import NamedSharding, PartitionSpec
+  from jax.tree_util import (keystr, tree_flatten_with_path,
+                             tree_unflatten)
+  param_paths = sorted(
+      ((keystr(p), leaf.sharding)
+       for p, leaf in tree_flatten_with_path(params)[0]),
+      key=lambda kv: -len(kv[0]))
+  rep = NamedSharding(mesh, PartitionSpec())
+  flat, treedef = tree_flatten_with_path(opt_state)
+  placed = []
+  for path, leaf in flat:
+    ks = keystr(path)
+    sharding = next((sh for pp, sh in param_paths if ks.endswith(pp)), rep)
+    placed.append(jax.device_put(leaf, sharding))
+  return tree_unflatten(treedef, placed)
+
+
+@dataclasses.dataclass
+class TrainLoop:
+  """Owns model/optimizer state, the loader, and the step function."""
+
+  model: object
+  tx: object
+  mesh: object
+  loader: object
+  params: object
+  opt_state: object
+  rng: object
+  step_fn: object
+  samples_seen: int = 0
+  step: int = 0
+  _last_saved: int = dataclasses.field(default=-1, repr=False)
+
+  @classmethod
+  def build(cls, path, tokenizer, *, model_cfg, mesh, learning_rate=1e-4,
+            warmup_steps=100, total_steps=10000, weight_decay=0.01,
+            batch_size_per_rank=64, bin_size=None, max_seq_length=512,
+            masking='dynamic', seed=127, samples_seen=0, loader_kwargs=None):
+    import jax
+    import optax
+
+    from ..loader import get_bert_pretrain_data_loader
+    from ..models import BertForPretraining
+    from ..parallel import make_train_step
+    from ..parallel.train import init_params
+
+    model = BertForPretraining(model_cfg, mesh=mesh)
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    tx = optax.adamw(schedule, weight_decay=weight_decay)
+    dp_rank, dp_world = jax.process_index(), jax.process_count()
+    loader = get_bert_pretrain_data_loader(
+        path,
+        dp_rank=dp_rank,
+        dp_world_size=dp_world,
+        batch_size_per_rank=batch_size_per_rank,
+        tokenizer=tokenizer,
+        masking=masking,
+        max_seq_length=max_seq_length,
+        bin_size=bin_size,
+        base_seed=seed,
+        samples_seen=samples_seen,
+        **(loader_kwargs or {}))
+    params = init_params(model, mesh, jax.random.key(seed),
+                         seq_len=min(128, max_seq_length))
+    opt_state = _place_opt_state(jax.jit(tx.init)(params), params, mesh)
+    step_fn = make_train_step(model, tx, mesh)
+    global_batch = batch_size_per_rank * dp_world
+    return cls(model=model, tx=tx, mesh=mesh, loader=loader, params=params,
+               opt_state=opt_state, rng=jax.random.key(seed + 1),
+               step_fn=step_fn, samples_seen=samples_seen,
+               step=samples_seen // global_batch)
+
+  # ---- checkpointing ----
+
+  def _manager(self, ckpt_dir, keep=3):
+    import orbax.checkpoint as ocp
+    return ocp.CheckpointManager(
+        os.path.abspath(ckpt_dir),
+        options=ocp.CheckpointManagerOptions(max_to_keep=keep,
+                                             create=True))
+
+  def save(self, ckpt_dir, keep=3):
+    """Write (params, opt_state, rng, counters) at the current step."""
+    import jax
+    import orbax.checkpoint as ocp
+    mngr = self._manager(ckpt_dir, keep)
+    state = {'params': self.params, 'opt_state': self.opt_state,
+             'rng': jax.random.key_data(self.rng)}
+    mngr.save(
+        self.step,
+        args=ocp.args.Composite(
+            state=ocp.args.StandardSave(state),
+            meta=ocp.args.JsonSave({'samples_seen': self.samples_seen,
+                                    'step': self.step})))
+    mngr.wait_until_finished()
+    mngr.close()
+    self._last_saved = self.step
+    return self.step
+
+  @staticmethod
+  def latest_meta(ckpt_dir):
+    """(step, samples_seen) of the newest checkpoint, or None."""
+    import orbax.checkpoint as ocp
+    if not os.path.isdir(ckpt_dir):
+      return None
+    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    step = mngr.latest_step()
+    if step is None:
+      mngr.close()
+      return None
+    meta = mngr.restore(step, args=ocp.args.Composite(
+        meta=ocp.args.JsonRestore()))['meta']
+    mngr.close()
+    return meta['step'], meta['samples_seen']
+
+  def restore(self, ckpt_dir):
+    """Restore sharded state from the newest checkpoint in ``ckpt_dir``.
+
+    The loader must already have been built with the checkpoint's
+    ``samples_seen`` (use :meth:`latest_meta` before :meth:`build`);
+    this method restores the device state onto the existing shardings.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+    mngr = self._manager(ckpt_dir)
+    step = mngr.latest_step()
+    if step is None:
+      raise FileNotFoundError(f'no checkpoint under {ckpt_dir}')
+    target = {'params': self.params, 'opt_state': self.opt_state,
+              'rng': jax.random.key_data(self.rng)}
+    restored = mngr.restore(
+        step,
+        args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(target),
+            meta=ocp.args.JsonRestore()))
+    mngr.close()
+
+    # Re-place every leaf onto the template's sharding: orbax restores
+    # unsharded scalars (e.g. the optimizer step count) onto a single
+    # device, which would then conflict with the mesh-sharded params
+    # inside the jitted step.
+    def _like(new, old):
+      return jax.tree_util.tree_map(
+          lambda n, o: jax.device_put(n, o.sharding), new, old)
+
+    self.params = _like(restored['state']['params'], self.params)
+    self.opt_state = _like(restored['state']['opt_state'], self.opt_state)
+    self.rng = jax.random.wrap_key_data(restored['state']['rng'])
+    self.step = restored['meta']['step']
+    self.samples_seen = restored['meta']['samples_seen']
+    self._last_saved = self.step  # this step already exists on disk
+    return self
+
+  # ---- the loop ----
+
+  def run(self, max_steps, ckpt_dir=None, ckpt_every=0, log_every=50,
+          prefetch=2):
+    """Train until ``max_steps`` (global); returns per-step loss list."""
+    import jax
+
+    from ..loader.device import prefetch_to_device
+
+    global_batch = (self.loader._batch *  # noqa: SLF001 (own class)
+                    max(jax.process_count(), 1))
+    losses = []
+    while self.step < max_steps:
+      stream = prefetch_to_device(iter(self.loader), mesh=self.mesh,
+                                  size=prefetch)
+      t0 = time.perf_counter()
+      steps_this_epoch = 0
+      for batch in stream:
+        steps_this_epoch += 1
+        self.params, self.opt_state, metrics = self.step_fn(
+            self.params, self.opt_state, self.rng, batch)
+        loss = float(metrics['loss'])
+        losses.append(loss)
+        self.step += 1
+        self.samples_seen += global_batch
+        if log_every and self.step % log_every == 0:
+          dt = time.perf_counter() - t0
+          t0 = time.perf_counter()
+          print(f'step={self.step} loss={loss:.4f} '
+                f'samples_seen={self.samples_seen} '
+                f'({log_every * global_batch / max(dt, 1e-9):.1f} '
+                'samples/s)')
+        if ckpt_dir and ckpt_every and self.step % ckpt_every == 0:
+          self.save(ckpt_dir)
+        if self.step >= max_steps:
+          break
+      stream.close()
+      if steps_this_epoch == 0:
+        raise ValueError(
+            'loader yielded zero batches for a full epoch (dataset smaller '
+            'than one global batch?); refusing to spin — reduce '
+            '--batch-size or provide more data')
+    # Skip when the in-loop ckpt_every save (or the restore we started
+    # from) already covers this step: orbax refuses duplicate steps.
+    if ckpt_dir and self._last_saved != self.step:
+      self.save(ckpt_dir)
+    return losses
+
+
+MODEL_SIZES = {
+    'tiny': dict(hidden_size=128, num_layers=2, num_heads=2,
+                 intermediate_size=512),
+    'base': dict(hidden_size=768, num_layers=12, num_heads=12,
+                 intermediate_size=3072),
+    'large': dict(hidden_size=1024, num_layers=24, num_heads=16,
+                  intermediate_size=4096),
+}
+
+
+def attach_args(parser):
+  parser.add_argument('--path', required=True, help='balanced shard dir')
+  parser.add_argument('--vocab-file', default=None)
+  parser.add_argument('--tokenizer', default=None)
+  parser.add_argument('--model', choices=sorted(MODEL_SIZES),
+                      default='base')
+  parser.add_argument('--attention',
+                      choices=['dense', 'flash', 'ring', 'ring_flash'],
+                      default='dense')
+  parser.add_argument('--remat', action='store_true')
+  parser.add_argument('--dp', type=int, default=1)
+  parser.add_argument('--fsdp', type=int, default=1)
+  parser.add_argument('--tp', type=int, default=1)
+  parser.add_argument('--sp', type=int, default=1)
+  parser.add_argument('--batch-size', type=int, default=64,
+                      help='per-process samples per step')
+  parser.add_argument('--bin-size', type=int, default=None)
+  parser.add_argument('--max-seq-length', type=int, default=512)
+  parser.add_argument('--masking', choices=['dynamic', 'static'],
+                      default='dynamic')
+  parser.add_argument('--steps', type=int, default=1000)
+  parser.add_argument('--learning-rate', type=float, default=1e-4)
+  parser.add_argument('--warmup-steps', type=int, default=100)
+  parser.add_argument('--weight-decay', type=float, default=0.01)
+  parser.add_argument('--seed', type=int, default=127)
+  parser.add_argument('--checkpoint-dir', default=None)
+  parser.add_argument('--checkpoint-every', type=int, default=500)
+  parser.add_argument('--log-every', type=int, default=50)
+  parser.add_argument('--resume', action='store_true',
+                      help='resume from the newest checkpoint in '
+                           '--checkpoint-dir (model state AND data '
+                           'stream position)')
+  parser.add_argument('--comm', choices=['null', 'file', 'jax'],
+                      default='null')
+  return parser
+
+
+def main(args=None):
+  if args is None or isinstance(args, list):
+    args = attach_args(argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)).parse_args(
+            args)
+  import jax
+
+  from ..comm import get_backend
+  from ..models import BertConfig
+  from ..parallel import make_mesh, mesh_summary
+  from ..tokenization.wordpiece import load_bert_tokenizer
+
+  get_backend(args.comm)  # bootstraps jax.distributed under --comm jax
+  tokenizer = load_bert_tokenizer(
+      vocab_file=args.vocab_file, hub_name=args.tokenizer, backend='hf')
+  vocab = ((tokenizer.vocab_size + 63) // 64) * 64
+  cfg = BertConfig(
+      vocab_size=vocab,
+      max_position_embeddings=max(args.max_seq_length, 512),
+      attention_impl=args.attention,
+      remat=args.remat,
+      **MODEL_SIZES[args.model])
+  mesh = make_mesh(data=args.dp, fsdp=args.fsdp, tensor=args.tp,
+                   seq=args.sp)
+  print(f'mesh: {mesh_summary(mesh)}; model={args.model} '
+        f'attention={args.attention}')
+
+  samples_seen = 0
+  resume = False
+  if args.resume and args.checkpoint_dir:
+    meta = TrainLoop.latest_meta(args.checkpoint_dir)
+    if meta is not None:
+      _, samples_seen = meta
+      resume = True
+      print(f'resuming from samples_seen={samples_seen}')
+
+  loop = TrainLoop.build(
+      args.path, tokenizer, model_cfg=cfg, mesh=mesh,
+      learning_rate=args.learning_rate, warmup_steps=args.warmup_steps,
+      total_steps=args.steps, weight_decay=args.weight_decay,
+      batch_size_per_rank=args.batch_size, bin_size=args.bin_size,
+      max_seq_length=args.max_seq_length, masking=args.masking,
+      seed=args.seed, samples_seen=samples_seen)
+  if resume:
+    loop.restore(args.checkpoint_dir)
+  losses = loop.run(args.steps, ckpt_dir=args.checkpoint_dir,
+                    ckpt_every=args.checkpoint_every,
+                    log_every=args.log_every)
+  if losses:
+    print(json.dumps({'final_step': loop.step,
+                      'final_loss': round(losses[-1], 4),
+                      'samples_seen': loop.samples_seen}))
+  return loop
+
+
+if __name__ == '__main__':
+  main()

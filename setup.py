@@ -15,6 +15,7 @@ setup(
         'jax',
         'flax',
         'optax',
+        'orbax-checkpoint',
         'transformers',
     ],
     extras_require={
@@ -33,6 +34,7 @@ setup(
             'preprocess_codebert_pretrain='
             'lddl_tpu.cli:preprocess_codebert_pretrain',
             'prepare_codesearchnet=lddl_tpu.cli:prepare_codesearchnet',
+            'pretrain_bert=lddl_tpu.cli:pretrain_bert',
             'balance_shards=lddl_tpu.cli:balance_shards',
             'generate_num_samples_cache='
             'lddl_tpu.cli:generate_num_samples_cache',
