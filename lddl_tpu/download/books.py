@@ -12,7 +12,7 @@ import os
 import subprocess
 
 from ..core import attach_bool_arg
-from .utils import download_file, shard_documents
+from .utils import download_file, shard_text_files_parallel
 
 # Canonical public mirror (same dataset the reference fetches,
 # books.py:38); often rate-limited — override with --url if needed.
@@ -20,14 +20,29 @@ _URL = ('https://the-eye.eu/public/AI/pile_preliminary_components/'
         'books1.tar.gz')
 
 
+def _parse_book_file(path):
+  """One extracted book file -> a single (book-<name>, text) document."""
+  name = os.path.splitext(os.path.basename(path))[0]
+  with open(path, encoding='utf-8', errors='ignore') as f:
+    yield f'book-{name}', f.read()
+
+
 def read_books(books_dir):
   """Yield (book-<name>, text) for every ``.epub.txt`` under books_dir."""
   paths = sorted(
       glob.glob(os.path.join(books_dir, '**', '*.txt'), recursive=True))
   for p in paths:
-    name = os.path.splitext(os.path.basename(p))[0]
-    with open(p, encoding='utf-8', errors='ignore') as f:
-      yield f'book-{name}', f.read()
+    yield from _parse_book_file(p)
+
+
+def shard_books(books_dir, outdir, num_shards, num_workers=None):
+  """Parallel scatter/concat sharding (one worker per input file batch;
+  the reference shards books with a Pool too, ``books.py:186-187``)."""
+  paths = sorted(
+      glob.glob(os.path.join(books_dir, '**', '*.txt'), recursive=True))
+  return shard_text_files_parallel(paths, outdir, num_shards,
+                                   _parse_book_file,
+                                   num_workers=num_workers)
 
 
 def untar(tar_path, outdir):
@@ -40,6 +55,8 @@ def attach_args(parser):
   parser.add_argument('--url', type=str, default=_URL,
                       help='books1.tar.gz mirror URL')
   parser.add_argument('--num-shards', type=int, default=256)
+  parser.add_argument('--num-workers', type=int, default=None,
+                      help='processes for shard prep (default: all cores)')
   attach_bool_arg(parser, 'download', default=True)
   attach_bool_arg(parser, 'extract', default=True)
   attach_bool_arg(parser, 'shard', default=True)
@@ -58,8 +75,8 @@ def main(args=None):
   if args.extract:
     untar(tar_path, extract_dir)
   if args.shard:
-    counts = shard_documents(read_books(extract_dir), source,
-                             args.num_shards)
+    counts = shard_books(extract_dir, source, args.num_shards,
+                         num_workers=args.num_workers)
     print(f'sharded {sum(counts)} books into {len(counts)} shards '
           f'under {source}')
 

@@ -11,7 +11,7 @@ import os
 import subprocess
 
 from ..core import attach_bool_arg
-from .utils import shard_documents
+from .utils import shard_text_files_parallel
 
 _GDRIVE_URL = ('https://drive.google.com/uc?id='
                '1EA5V0oetDCOke7afsktL_JDQ-ETtNOvx')
@@ -37,19 +37,36 @@ def unpack(archive_path, extract_dir):
     subprocess.run(['tar', '-xJf', sub, '-C', subdir], check=True)
 
 
+def _parse_page_file(path):
+  """One extracted page file -> a single (openweb-<name>, text) document."""
+  name = os.path.splitext(os.path.basename(path))[0]
+  with open(path, encoding='utf-8', errors='ignore') as f:
+    yield f'openweb-{name}', f.read()
+
+
 def read_pages(extract_dir):
   """Yield (openweb-<name>, text) for every extracted page ``.txt``."""
   for p in sorted(
       glob.glob(os.path.join(extract_dir, '**', '*.txt'), recursive=True)):
-    name = os.path.splitext(os.path.basename(p))[0]
-    with open(p, encoding='utf-8', errors='ignore') as f:
-      yield f'openweb-{name}', f.read()
+    yield from _parse_page_file(p)
+
+
+def shard_pages(extract_dir, outdir, num_shards, num_workers=None):
+  """Parallel scatter/concat sharding (reference pools page sharding too,
+  ``openwebtext.py:160-167``)."""
+  paths = sorted(
+      glob.glob(os.path.join(extract_dir, '**', '*.txt'), recursive=True))
+  return shard_text_files_parallel(paths, outdir, num_shards,
+                                   _parse_page_file,
+                                   num_workers=num_workers)
 
 
 def attach_args(parser):
   parser.add_argument('--outdir', type=str, required=True)
   parser.add_argument('--url', type=str, default=_GDRIVE_URL)
   parser.add_argument('--num-shards', type=int, default=256)
+  parser.add_argument('--num-workers', type=int, default=None,
+                      help='processes for shard prep (default: all cores)')
   attach_bool_arg(parser, 'download', default=True)
   attach_bool_arg(parser, 'extract', default=True)
   attach_bool_arg(parser, 'shard', default=True)
@@ -68,8 +85,8 @@ def main(args=None):
   if args.extract:
     unpack(archive, extract_dir)
   if args.shard:
-    counts = shard_documents(read_pages(extract_dir), source,
-                             args.num_shards)
+    counts = shard_pages(extract_dir, source, args.num_shards,
+                         num_workers=args.num_workers)
     print(f'sharded {sum(counts)} pages into {len(counts)} shards '
           f'under {source}')
 

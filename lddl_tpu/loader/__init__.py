@@ -8,6 +8,7 @@ static MLM masking, model-parallel (dp-group) feeding, micro-batching with
 loss masks, and mid-epoch ``samples_seen`` resume.
 """
 
+from .bart import get_bart_pretrain_data_loader
 from .bert import get_bert_pretrain_data_loader
 from .binned import BinnedIterator
 from .codebert import get_codebert_pretrain_data_loader
@@ -15,6 +16,7 @@ from .dataset import ParquetShardDataset
 from .shuffle_buffer import ShuffleBuffer
 
 __all__ = [
+    'get_bart_pretrain_data_loader',
     'get_bert_pretrain_data_loader',
     'get_codebert_pretrain_data_loader',
     'BinnedIterator',

@@ -1,0 +1,158 @@
+"""BART pretraining loader: text-infilling batches from `sentences` shards.
+
+The reference ships only the BART *preprocessor* (raw sentence chunks,
+``lddl/dask/bart/pretrain.py``) and leaves loading/noising to external
+trainers. Here the loader is first-class: it consumes the preprocessor's
+``sentences`` Parquet shards (schema ``bart/pretrain.py:136-152``) and
+applies BART's text-infilling objective at load time — the seq2seq
+analogue of the BERT loader's dynamic masking:
+
+  - tokenize each chunk (one batched tokenizer call);
+  - sample noise spans, length ~ Poisson(lambda=3), covering
+    ``noise_density`` (default 0.3) of the tokens, and collapse each span
+    to a single ``[MASK]``/``<mask>`` token (BART "text infilling");
+  - emit fixed-shape numpy batches: corrupted ``input_ids`` +
+    ``attention_mask`` (encoder side), original ``labels`` with -100 at
+    padding (decoder target), and ``decoder_input_ids`` (labels shifted
+    right, BOS-first) for standard seq2seq training loops.
+
+Every random draw comes from a Philox generator keyed by
+``(seed, epoch, dp_rank, step)`` — the same resumable-determinism scheme
+as :class:`~lddl_tpu.loader.bert.BertCollate`.
+"""
+
+import numpy as np
+
+from .bert import IGNORE_INDEX, build_pretrain_loader
+
+
+class BartCollate:
+  """Rows {'sentences': str} -> text-infilling batch dict."""
+
+  def __init__(self, tokenizer, noise_density=0.3, poisson_lambda=3.0,
+               base_seed=12345, dp_rank=0):
+    # Accept either the framework's BertWordPiece wrapper or a bare HF
+    # tokenizer; batch encoding goes through the HF fast tokenizer.
+    self._hf = getattr(tokenizer, 'hf', tokenizer)
+    self._density = noise_density
+    self._lambda = poisson_lambda
+    self._base_seed = base_seed
+    self._dp_rank = dp_rank
+    self._mask_id = tokenizer.mask_token_id
+    self._pad_id = (tokenizer.pad_token_id
+                    if tokenizer.pad_token_id is not None else 0)
+    bos = getattr(self._hf, 'bos_token_id', None)
+    self._bos_id = bos if bos is not None else tokenizer.cls_token_id
+    if self._mask_id is None:
+      raise ValueError('tokenizer defines no mask token; text infilling '
+                       'requires one')
+
+  def _rng(self, epoch, step):
+    return np.random.Generator(
+        np.random.Philox(key=[
+            np.uint64(self._base_seed) << np.uint64(32) | np.uint64(epoch),
+            np.uint64(self._dp_rank) << np.uint64(32) | np.uint64(step),
+        ]))
+
+  def _noise_spans(self, n, rng):
+    """Start/length pairs of non-overlapping spans covering ~density*n."""
+    budget = int(round(n * self._density))
+    taken = np.zeros(n, dtype=bool)
+    spans = []
+    tries = 0
+    while budget > 0 and tries < 8 * max(1, n):
+      tries += 1
+      length = max(1, int(rng.poisson(self._lambda)))
+      length = min(length, budget) or 1
+      start = int(rng.integers(0, max(1, n - length + 1)))
+      if taken[start:start + length].any():
+        continue
+      taken[start:start + length] = True
+      spans.append((start, length))
+      budget -= length
+    return sorted(spans)
+
+  def __call__(self, rows, seq_len, epoch, step):
+    texts = [row['sentences'] for row in rows]
+    enc = self._hf(texts, truncation=True, max_length=seq_len,
+                   add_special_tokens=True)
+    rng = self._rng(epoch, step)
+    n = len(rows)
+    input_ids = np.full((n, seq_len), self._pad_id, dtype=np.int32)
+    attention_mask = np.zeros((n, seq_len), dtype=np.int32)
+    labels = np.full((n, seq_len), IGNORE_INDEX, dtype=np.int32)
+    decoder_input_ids = np.full((n, seq_len), self._pad_id, dtype=np.int32)
+
+    for i, ids in enumerate(enc['input_ids']):
+      ids = np.asarray(ids, dtype=np.int32)
+      labels[i, :len(ids)] = ids
+      decoder_input_ids[i, 0] = self._bos_id
+      decoder_input_ids[i, 1:len(ids)] = ids[:-1]
+      corrupted = []
+      pos = 0
+      for start, length in self._noise_spans(len(ids), rng):
+        corrupted.extend(ids[pos:start])
+        corrupted.append(self._mask_id)
+        pos = start + length
+      corrupted.extend(ids[pos:])
+      corrupted = np.asarray(corrupted[:seq_len], dtype=np.int32)
+      input_ids[i, :len(corrupted)] = corrupted
+      attention_mask[i, :len(corrupted)] = 1
+    return {
+        'input_ids': input_ids,
+        'attention_mask': attention_mask,
+        'labels': labels,
+        'decoder_input_ids': decoder_input_ids,
+    }
+
+
+def get_bart_pretrain_data_loader(
+    path,
+    dp_rank=0,
+    dp_world_size=1,
+    batch_size_per_rank=64,
+    vocab_file=None,
+    tokenizer_name=None,
+    lowercase=True,
+    noise_density=0.3,
+    poisson_lambda=3.0,
+    max_seq_length=128,
+    shuffle_buffer_size=16384,
+    shuffle_buffer_warmup_factor=16,
+    base_seed=12345,
+    start_epoch=0,
+    samples_seen=0,
+    comm=None,
+    tokenizer=None,
+    log_dir=None,
+    log_level=None,
+):
+  """Loader over (unbinned) BART `sentences` shards; mirrors
+  :func:`lddl_tpu.loader.get_bert_pretrain_data_loader`."""
+  if tokenizer is None:
+    from ..tokenization.wordpiece import load_bert_tokenizer
+    tokenizer = load_bert_tokenizer(
+        vocab_file=vocab_file, hub_name=tokenizer_name, lowercase=lowercase,
+        backend='hf')
+  collate = BartCollate(
+      tokenizer,
+      noise_density=noise_density,
+      poisson_lambda=poisson_lambda,
+      base_seed=base_seed,
+      dp_rank=dp_rank)
+  return build_pretrain_loader(
+      path,
+      collate,
+      dp_rank=dp_rank,
+      dp_world_size=dp_world_size,
+      batch_size_per_rank=batch_size_per_rank,
+      max_seq_length=max_seq_length,
+      bin_size=None,
+      shuffle_buffer_size=shuffle_buffer_size,
+      shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+      base_seed=base_seed,
+      start_epoch=start_epoch,
+      samples_seen=samples_seen,
+      comm=comm,
+      log_dir=log_dir,
+      log_level=log_level)

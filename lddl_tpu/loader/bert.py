@@ -350,6 +350,7 @@ def get_bert_pretrain_data_loader(
     tokenizer=None,
     log_dir=None,
     log_level=None,
+    return_raw_samples=False,
 ):
   """Build the BERT pretraining loader over a balanced shard directory.
 
@@ -358,7 +359,22 @@ def get_bert_pretrain_data_loader(
   ``bin_size``: token width of each bin; required when ``path`` holds
   binned shards (``*.parquet_<bin>``). ``samples_seen``: global samples
   already consumed, for mid-epoch resume (torch_mp parity).
+  ``return_raw_samples``: yield the raw row dicts (lists per batch)
+  instead of collated arrays — the reference's debug/eyeballing mode
+  (``torch/bert.py:253``).
   """
+  if return_raw_samples:
+    collate = lambda rows, seq_len, epoch, step: rows
+    return build_pretrain_loader(
+        path, collate, dp_rank=dp_rank, dp_world_size=dp_world_size,
+        batch_size_per_rank=batch_size_per_rank,
+        max_seq_length=max_seq_length, bin_size=bin_size,
+        sequence_length_alignment=sequence_length_alignment,
+        shuffle_buffer_size=shuffle_buffer_size,
+        shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+        base_seed=base_seed, start_epoch=start_epoch,
+        samples_seen=samples_seen, comm=comm, log_dir=log_dir,
+        log_level=log_level)
   if tokenizer is None:
     from ..tokenization.wordpiece import load_bert_tokenizer
     # hf backend: loaders only convert ids/decode — the native encoder (and
