@@ -12,6 +12,7 @@ import datetime
 import glob
 import os
 import threading
+import uuid
 
 from ..core import attach_bool_arg
 
@@ -25,7 +26,10 @@ class ArticleSink:
   ``common_crawl.py:310-352``, which silently loses worker-process
   buffers — here a forked child detects the pid change, drops the
   buffers it inherited (the parent owns flushing those), namespaces its
-  spool files and doc ids by pid, and registers its own exit flush)."""
+  spool files and doc ids by a per-process unique token (a recycled pid
+  alone would collide: the spool file would be reopened in append mode
+  with the doc counter restarted, duplicating ids), and registers its
+  own exit flush)."""
 
   def __init__(self, spool_dir, articles_per_flush=512):
     self._dir = spool_dir
@@ -36,6 +40,7 @@ class ArticleSink:
     self._lock = threading.Lock()
     self._all_buffers = []  # [(buf, path)] so a final flush sees every thread
     self._pid = os.getpid()
+    self._ns = uuid.uuid4().hex[:12]
     # Never replaced after construction (unlike _lock, which a post-fork
     # reset swaps), so it can safely serialize the reset itself. The
     # parent only ever acquires it here in __init__-time registration
@@ -68,6 +73,7 @@ class ArticleSink:
       self._count = 0
       self._local = threading.local()
       self._lock = threading.Lock()
+      self._ns = uuid.uuid4().hex[:12]  # fresh namespace: pids recycle
       self._register_exit_flush()
       self._pid = pid  # last: gates the unsynchronized fast path
 
@@ -76,7 +82,7 @@ class ArticleSink:
     if buf is None:
       self._local.buf = buf = []
       self._local.path = os.path.join(
-          self._dir, f'articles-{self._pid}-{threading.get_ident()}.txt')
+          self._dir, f'articles-{self._ns}-{threading.get_ident()}.txt')
       with self._lock:
         self._all_buffers.append((buf, self._local.path))
     return buf
@@ -92,7 +98,7 @@ class ArticleSink:
       self._count += 1
       idx = self._count
     one_line = ' '.join((title + ' ' + text).split())
-    buf.append(f'ccnews-{self._pid}-{idx} {one_line}\n')
+    buf.append(f'ccnews-{self._ns}-{idx} {one_line}\n')
     if len(buf) >= self._per_flush:
       self._write(buf, self._local.path)
 

@@ -25,6 +25,9 @@ _PEAK_TFLOPS_BF16 = (
     ('v5p', 459.0),
     ('v5 lite', 197.0),
     ('v5e', 197.0),
+    # jax reports v5p as plain 'TPU v5' — this entry must stay after the
+    # lite/v5e keys so they win for the lite chips.
+    ('v5', 459.0),
     ('v4', 275.0),
     ('v3', 123.0),
     ('v2', 45.0),
@@ -39,6 +42,11 @@ def peak_flops_per_device(device=None):
   for key, tflops in _PEAK_TFLOPS_BF16:
     if key in kind:
       return tflops * 1e12
+  if 'tpu' in kind:
+    import warnings
+    warnings.warn(
+        f'no peak-FLOPs entry for device_kind {device.device_kind!r}; '
+        'MFU will be omitted — pass --peak-tflops to report it')
   return None
 
 

@@ -17,6 +17,11 @@ def plan_pairs_partition_native(docs, rng, max_seq_length=128,
   """Native planner; same contract as the Python
   ``plan_pairs_partition`` (returns (a_ranges, b_ranges, is_random_next)
   and advances ``rng`` draw-for-draw)."""
+  if max_seq_length < 5:
+    # Same contract as the Python path: randint(2, max_seq_length - 3)
+    # has an empty range below 5 and CPython raises — the C++ planner
+    # cannot, so reject here before it runs.
+    raise ValueError(f'max_seq_length must be >= 5, got {max_seq_length}')
   lib = load_library()
   version, state, gauss = rng.getstate()
   mt = np.array(state[:624], dtype=np.uint32)

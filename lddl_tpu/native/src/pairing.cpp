@@ -63,7 +63,11 @@ struct PyRandom {
   // k must be in [1, 32] (all widths in the planner fit 32 bits).
   uint32_t getrandbits(int k) { return genrand() >> (32 - k); }
 
-  int64_t randbelow(int64_t n) {  // n >= 1
+  int64_t randbelow(int64_t n) {
+    // n < 1 would make __builtin_clzll(0) UB; callers validate
+    // (max_seq_length >= 5 is enforced Python-side, mirroring CPython's
+    // ValueError for an empty randint range), so this is pure defense.
+    if (n < 1) return 0;
     int k = 64 - __builtin_clzll(static_cast<uint64_t>(n));  // bit_length
     uint32_t r = getrandbits(k);
     while (static_cast<int64_t>(r) >= n) r = getrandbits(k);

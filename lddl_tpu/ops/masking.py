@@ -129,10 +129,14 @@ def mask_batch_host(ids_mat, row_len, na, *, masked_lm_ratio, vocab_size,
     k = np.minimum(k, max_predictions)
   k = np.minimum(k, valid.sum(axis=1))
   # rank of each u within its row; the k smallest valid entries win.
-  # Default (unstable) sort: ~2x faster than mergesort here, and equal
-  # float64 draws are measure-zero, so the selection is still a
-  # deterministic function of the Philox stream.
-  order = np.argsort(u, axis=1)
+  # Sort tie-free uint64 keys (positive-float bit patterns order like the
+  # floats; the lane index replaces the low mantissa bits) so the fast
+  # default introsort is deterministic across numpy versions — equal
+  # float64 draws would otherwise tie-break by sort implementation.
+  lane_bits = max(1, (l - 1)).bit_length()
+  keys = (u.view(np.uint64) & ~np.uint64((1 << lane_bits) - 1)
+          | np.arange(l, dtype=np.uint64)[None, :])
+  order = np.argsort(keys, axis=1)
   ranks = np.empty_like(order)
   rows = np.arange(n)[:, None]
   ranks[rows, order] = np.arange(l)[None, :]

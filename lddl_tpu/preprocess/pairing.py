@@ -168,6 +168,12 @@ def plan_pairs_partition(docs, rng, max_seq_length=128, short_seq_prob=0.1,
   ``LDDL_PAIRING=python`` to force the Python path); 'python' forces this
   module's loop.
   """
+  if max_seq_length < 5:
+    # The short-seq draw is randint(2, max_seq_length - 3); below 5 the
+    # range is empty and CPython raises — validate up front so the native
+    # planner (which cannot raise mid-plan) never sees the degenerate
+    # config.
+    raise ValueError(f'max_seq_length must be >= 5, got {max_seq_length}')
   if backend == 'auto':
     native = _native_planner()
     if native is not None:
