@@ -166,69 +166,6 @@ class TestDecode:
       pickle.dumps(nat)
 
 
-class TestPairingRandomizedParity:
-  """Native planner vs Python planner: outputs AND post-call rng state must
-  be bit-identical over randomized configs. Skips (never silently falls
-  back) when the native toolchain is unavailable."""
-
-  @pytest.fixture(scope='class')
-  def native_planner(self):
-    try:
-      from lddl_tpu.native.build import load_library
-      load_library()
-    except Exception as e:
-      pytest.skip(f'native library unavailable: {e}')
-    from lddl_tpu.native.pairing import plan_pairs_partition_native
-    return plan_pairs_partition_native
-
-  @staticmethod
-  def _random_docs(r):
-    from lddl_tpu.preprocess.pairing import TokenizedDocs
-    n_docs = r.randrange(1, 7)
-    sent_lens, doc_counts = [], []
-    for _ in range(n_docs):
-      k = r.randrange(1, 8)
-      doc_counts.append(k)
-      sent_lens.extend(r.randrange(1, 30) for _ in range(k))
-    offsets = np.zeros(len(sent_lens) + 1, dtype=np.int64)
-    np.cumsum(sent_lens, out=offsets[1:])
-    return TokenizedDocs(
-        np.arange(offsets[-1], dtype=np.int32) % 97, offsets, doc_counts)
-
-  def test_200_randomized_trials(self, native_planner):
-    from lddl_tpu.preprocess.pairing import plan_pairs_partition
-    meta = random.Random(0xC0FFEE)
-    for trial in range(200):
-      docs = self._random_docs(meta)
-      max_seq = meta.randrange(5, 65)
-      short = meta.choice((0.0, 0.1, 0.5, 1.0))
-      dup = meta.randrange(1, 4)
-      seed = meta.getrandbits(64)
-      rng_n, rng_p = random.Random(seed), random.Random(seed)
-      a_n, b_n, ir_n = native_planner(
-          docs, rng_n, max_seq_length=max_seq, short_seq_prob=short,
-          duplicate_factor=dup)
-      a_p, b_p, ir_p = plan_pairs_partition(
-          docs, rng_p, max_seq_length=max_seq, short_seq_prob=short,
-          duplicate_factor=dup, backend='python')
-      ctx = f'trial={trial} max_seq={max_seq} short={short} dup={dup}'
-      assert np.array_equal(a_n, a_p), ctx
-      assert np.array_equal(b_n, b_p), ctx
-      assert np.array_equal(ir_n, ir_p), ctx
-      assert rng_n.getstate() == rng_p.getstate(), ctx
-
-  def test_degenerate_max_seq_length_raises(self, native_planner):
-    """max_seq_length <= 4 makes the short-seq randint range empty; both
-    paths must reject it up front (CPython raises ValueError there — the
-    native planner cannot, so the dispatcher validates)."""
-    from lddl_tpu.preprocess.pairing import plan_pairs_partition
-    docs = self._random_docs(random.Random(1))
-    for backend in ('auto', 'python'):
-      with pytest.raises(ValueError, match='max_seq_length'):
-        plan_pairs_partition(docs, random.Random(2), max_seq_length=4,
-                             backend=backend)
-
-
 def test_pairing_falls_back_without_toolchain(monkeypatch):
   """A host without g++ must degrade to the Python planner with a warning,
   not crash at first use (the build runs lazily inside the probe)."""
