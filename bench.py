@@ -6,75 +6,45 @@ Prints ONE JSON line:
 
 ``value`` is MB of raw one-document-per-line text turned into binned,
 masked NSP-pair Parquet shards per second per accelerator chip (the
-BASELINE.json north-star metric). ``vs_baseline`` compares against a
-faithful reimplementation of the reference's per-partition hot loop
-(per-sentence ``tokenizer.tokenize`` calls + per-token Python masking,
-reference ``lddl/dask/bert/pretrain.py:77-97,182-238``) run on the same
-corpus in the same process, so the ratio isolates the framework's
-pipeline improvements from hardware differences.
+BASELINE.json north-star metric), measured with the **real-scale
+tokenizer model**: a 30,522-entry trained WordPiece vocabulary
+(``benchmarks/assets/bench_vocab_30522.txt``, 4,754 ``##`` continuations
+— see ``benchmarks/make_bench_vocab.py``) over realistic text (Zipfian
+~50k-type word distribution, English-like morphology, punctuation /
+digits / non-ASCII at prose rates — :mod:`lddl_tpu.core.synth`). A toy
+vocab overstates throughput; this configuration makes longest-match do
+the same work Wikipedia+Books would (VERDICT r2 item 1).
 
-Corpus size: LDDL_BENCH_MB (default 16 — large enough that one-time
-process costs amortize as they do on a real multi-GB run). Baseline runs
-on a slice of the corpus and is scaled, bounded by LDDL_BENCH_BASELINE_MB
-(default 1).
+``vs_baseline`` compares against a faithful reimplementation of the
+reference's per-partition hot loop (per-sentence ``tokenizer.tokenize``
+calls + per-token Python masking, reference
+``lddl/dask/bert/pretrain.py:77-97,182-238``) run on a slice of the same
+corpus with the same vocab in the same process, so the ratio isolates
+the framework's pipeline improvements from hardware differences.
+
+Corpus size: LDDL_BENCH_MB (default 64 — a measurement window long
+enough that one-time process costs amortize as they do on a real
+multi-GB run). The baseline runs on LDDL_BENCH_BASELINE_MB (default 1)
+and is scaled.
 """
 
 import json
 import os
-import random
 import shutil
 import tempfile
 import time
 
-_STEMS = (
-    'run walk talk jump read write think build train learn model data file '
-    'shard token mask label batch layer device host chip mesh ring core '
-    'count plan test bench load store fetch merge split join scan sort '
-    'light dark fast slow large small deep wide long short open close').split()
-_SUFFIXES = ('ing', 'ed', 'er', 'ers', 's', 'ly', 'ness', 'able')
-
-
-def _build_vocab(path):
-  tokens = ['[PAD]', '[UNK]', '[CLS]', '[SEP]', '[MASK]', '.', ',']
-  tokens += _STEMS
-  tokens += ['##' + s for s in _SUFFIXES]
-  with open(path, 'w') as f:
-    f.write('\n'.join(tokens) + '\n')
-
-
-def _gen_corpus(src_dir, target_mb, num_shards=4, seed=1234):
-  """Synthetic one-document-per-line corpus; words are stem[+suffix] so
-  WordPiece actually exercises subword matching."""
-  r = random.Random(seed)
-  target = int(target_mb * 1024 * 1024)
-  os.makedirs(src_dir, exist_ok=True)
-  written, doc_id = 0, 0
-  files = [open(os.path.join(src_dir, f'{i}.txt'), 'w') for i in range(num_shards)]
-  while written < target:
-    sents = []
-    for _ in range(r.randrange(8, 24)):
-      n = r.randrange(6, 18)
-      words = []
-      for _ in range(n):
-        w = r.choice(_STEMS)
-        if r.random() < 0.45:
-          w += r.choice(_SUFFIXES)
-        words.append(w)
-      sents.append(' '.join(words).capitalize() + '.')
-    line = f'doc-{doc_id} ' + ' '.join(sents) + '\n'
-    files[doc_id % num_shards].write(line)
-    written += len(line)
-    doc_id += 1
-  for f in files:
-    f.close()
-  return written / (1024 * 1024)
+_VOCAB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      'benchmarks', 'assets', 'bench_vocab_30522.txt')
 
 
 def _reference_style_partition(lines, hf_tok, vocab_words, seed):
   """The reference's per-partition hot loop, reimplemented faithfully:
   per-sentence tokenize (``pretrain.py:79-91``), per-document pairing,
   per-token masking RNG loop (``pretrain.py:182-238``)."""
-  from lddl_tpu.preprocess.bert import create_pairs_from_document, Document
+  import random
+
+  from lddl_tpu.preprocess.bert import Document, create_pairs_from_document
   from lddl_tpu.preprocess.readers import split_id_text
   from lddl_tpu.tokenization import split_sentences
 
@@ -84,7 +54,7 @@ def _reference_style_partition(lines, hf_tok, vocab_words, seed):
     doc_id, text = split_id_text(line)
     sents = []
     for s in split_sentences(text, backend='rules'):
-      toks = hf_tok.tokenize(s, max_length=512, truncation=True)  # 1 call/sentence
+      toks = hf_tok.tokenize(s, max_length=512, truncation=True)  # 1 call/sent
       if toks:
         sents.append(tuple(toks))
     if sents:
@@ -98,14 +68,13 @@ def _reference_style_partition(lines, hf_tok, vocab_words, seed):
 
 
 def main():
-  corpus_mb = float(os.environ.get('LDDL_BENCH_MB', '16'))
+  corpus_mb = float(os.environ.get('LDDL_BENCH_MB', '64'))
   baseline_mb = float(os.environ.get('LDDL_BENCH_BASELINE_MB', '1'))
   work = tempfile.mkdtemp(prefix='lddl_bench_')
   try:
     src = os.path.join(work, 'source')
-    vocab = os.path.join(work, 'vocab.txt')
-    _build_vocab(vocab)
-    actual_mb = _gen_corpus(src, corpus_mb)
+    from lddl_tpu.core.synth import write_corpus
+    actual_mb = write_corpus(src, corpus_mb, num_shards=8, seed=1234)
 
     import jax
     num_chips = max(1, len(jax.devices()))
@@ -115,7 +84,7 @@ def main():
     from lddl_tpu.preprocess.readers import read_corpus
 
     cfg = BertPretrainConfig(
-        vocab_file=vocab,
+        vocab_file=_VOCAB,
         target_seq_length=128,
         bin_size=32,
         duplicate_factor=1,
@@ -130,8 +99,8 @@ def main():
     # One-time warmups outside the timed region (multi-GB runs amortize
     # them): tokenizer construction (builds the native .so on first use),
     # the device-link probe, and the jit masking kernel compile.
-    from lddl_tpu.preprocess.bert import _get_tokenizer
     from lddl_tpu.ops import mask_partition_device, resolve_mask_backend
+    from lddl_tpu.preprocess.bert import _get_tokenizer
     try:  # pyarrow lazily imports pandas (when present) on first table
       import pandas  # noqa: F401
     except ImportError:
@@ -146,6 +115,13 @@ def main():
           seq_len=cfg.target_seq_length, masked_lm_ratio=cfg.masked_lm_ratio,
           vocab_size=tok.vocab_size, mask_id=tok.mask_token_id,
           cls_id=tok.cls_token_id, sep_id=tok.sep_token_id, seed=0)
+    # One untimed pass first: the steady state a multi-GB run sits in
+    # (page cache holding the sources, warmed allocator/branch history)
+    # is reached only after the first tens of MB — measuring from cold
+    # start made round-2 numbers swing ~20% run to run.
+    run(corpus, os.path.join(work, 'sink_warm'), cfg, executor=executor)
+    shutil.rmtree(os.path.join(work, 'sink_warm'), ignore_errors=True)
+    corpus = read_corpus([src], num_blocks=4 * executor.num_local_workers)
     t0 = time.perf_counter()
     run(corpus, os.path.join(work, 'sink'), cfg, executor=executor)
     ours_s = time.perf_counter() - t0
@@ -153,16 +129,16 @@ def main():
 
     # Reference-style hot loop on a corpus slice, scaled.
     from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
-    tok = load_bert_tokenizer(vocab_file=vocab)
+    tok = load_bert_tokenizer(vocab_file=_VOCAB)
     lines, nbytes = [], 0
     budget = int(baseline_mb * 1024 * 1024)
     for name in sorted(os.listdir(src)):
-      with open(os.path.join(src, name)) as f:
+      with open(os.path.join(src, name), encoding='utf-8') as f:
         for line in f:
           if nbytes >= budget:
             break
           lines.append(line.rstrip('\n'))
-          nbytes += len(line)
+          nbytes += len(line.encode('utf-8'))
     t0 = time.perf_counter()
     _reference_style_partition(lines, tok.hf, tok.vocab_words, seed=42)
     ref_s = time.perf_counter() - t0
