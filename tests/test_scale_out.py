@@ -1,0 +1,208 @@
+"""World-size-8 scale-out equality for the full pipeline.
+
+Eight FileBackend processes run preprocess -> balance over a shared
+filesystem (the in-repo equivalent of the reference's multi-node launcher,
+``/root/reference/examples/slurm_example.sub:70-118``: N tasks, shared FS,
+metadata-only collectives) and must produce **byte-identical** output to
+the single-process run:
+
+  - every preprocessed ``part.N.parquet_<bin>`` file hash-equal,
+  - every balanced ``shard-N.parquet_<bin>`` file hash-equal,
+  - identical ``.num_samples.json``.
+
+Then the 8 data-parallel loader ranks drain the balanced shards: the
+binned iterator's exact-drain invariant must hold on every rank
+(reference assert ``torch/dataloader.py:91``) and the 8 ranks' sample
+sets must be pairwise disjoint and sum to the expected per-bin coverage
+(8 x the per-file minimum — min-truncation accounting).
+
+This is the test PERF.md's north-star arithmetic cites: rank-strided
+partitions are embarrassingly parallel, so world size cannot change the
+bytes on disk.
+"""
+
+import hashlib
+import multiprocessing as mp
+import os
+
+from lddl_tpu.balance import balance_directory, load_num_samples_cache
+from lddl_tpu.comm import FileBackend, NullBackend
+from lddl_tpu.core import get_all_bin_ids, get_all_parquets_under
+from lddl_tpu.loader import get_bert_pretrain_data_loader
+from lddl_tpu.pipeline import Executor, read_samples
+from lddl_tpu.pipeline.executor import Executor as _Executor  # noqa: F401
+from lddl_tpu.preprocess import bert
+from lddl_tpu.preprocess.readers import read_corpus
+
+WORLD = 8
+NUM_SHARDS = 8
+NUM_BLOCKS = 16
+SEED = 1234
+
+WORDS = [
+    'alpha', 'bravo', 'charlie', 'delta', 'echo', 'foxtrot', 'golf',
+    'hotel', 'india', 'juliet', 'kilo', 'lima', 'mike', 'november',
+]
+
+
+def _make_corpus(root):
+  """~160 docs with a wide sentence-count spread so all 4 bins fill."""
+  import random
+  r = random.Random(SEED)
+  src = os.path.join(root, 'source')
+  os.makedirs(src)
+  docs = []
+  for d in range(160):
+    n_sents = r.randrange(2, 40)
+    sents = []
+    for _ in range(n_sents):
+      n = r.randrange(4, 30)
+      sents.append(
+          (' '.join(r.choice(WORDS) for _ in range(n)) + '.').capitalize())
+    docs.append(f'doc-{d} ' + ' '.join(sents))
+  for shard in range(8):
+    with open(os.path.join(src, f'{shard}.txt'), 'w') as f:
+      for line in docs[shard::8]:
+        f.write(line + '\n')
+  return src
+
+
+def _make_vocab(root):
+  path = os.path.join(root, 'vocab.txt')
+  tokens = ['[PAD]', '[UNK]', '[CLS]', '[SEP]', '[MASK]', '.', ',']
+  tokens += WORDS
+  tokens += ['##' + w[1:] for w in WORDS]
+  with open(path, 'w') as f:
+    f.write('\n'.join(tokens) + '\n')
+  return path
+
+
+def _config(vocab):
+  return bert.BertPretrainConfig(
+      vocab_file=vocab,
+      target_seq_length=128,
+      bin_size=32,
+      duplicate_factor=2,
+      masking=True,
+      seed=SEED,
+      sentence_backend='rules',
+      engine='fast',
+      tokenizer_backend='hf',
+      mask_backend='host',
+  )
+
+
+def _preprocess_and_balance(src, sink, bal, vocab, comm):
+  executor = Executor(comm=comm, num_local_workers=1)
+  corpus = read_corpus(src, num_blocks=NUM_BLOCKS, sample_ratio=1.0)
+  bert.run(corpus, sink, _config(vocab), executor=executor,
+           num_shuffle_partitions=NUM_BLOCKS)
+  return balance_directory(sink, bal, NUM_SHARDS, comm)
+
+
+def _drain_rank(bal, rank, world):
+  """Drain one dp rank's epoch of raw rows; returns sample keys."""
+  loader = get_bert_pretrain_data_loader(
+      bal,
+      dp_rank=rank,
+      dp_world_size=world,
+      batch_size_per_rank=1,
+      bin_size=32,
+      base_seed=SEED,
+      comm=NullBackend(),  # .num_samples.json cache: no collectives needed
+      return_raw_samples=True,
+  )
+  keys = []
+  for rows in loader:  # exact-drain assert fires inside if violated
+    for row in rows:
+      keys.append((row['A'], row['B'], bool(row['is_random_next']),
+                   bytes(row['masked_lm_positions'])))
+  return keys
+
+
+def _worker(rank, rdzv, src, sink, bal, vocab, q):
+  try:
+    comm = FileBackend(rdzv, rank, WORLD, timeout=600.0)
+    meta = _preprocess_and_balance(src, sink, bal, vocab, comm)
+    drained = _drain_rank(bal, rank, WORLD)
+    q.put((rank, None, (meta, drained)))
+  except BaseException as e:  # surface the traceback in the parent
+    import traceback
+    q.put((rank, f'{e!r}\n{traceback.format_exc()}', None))
+    raise
+
+
+def _hash_dir(d):
+  out = {}
+  for p in get_all_parquets_under(d):
+    with open(p, 'rb') as f:
+      out[os.path.basename(p)] = hashlib.sha256(f.read()).hexdigest()
+  return out
+
+
+def test_world8_pipeline_matches_single_process(tmp_path):
+  root = str(tmp_path)
+  src = _make_corpus(root)
+  vocab = _make_vocab(root)
+
+  # Single-process reference run.
+  sink1 = os.path.join(root, 'sink_single')
+  bal1 = os.path.join(root, 'bal_single')
+  meta1 = _preprocess_and_balance(src, sink1, bal1, vocab, NullBackend())
+
+  # World-size-8 run over a shared sink.
+  sink8 = os.path.join(root, 'sink_w8')
+  bal8 = os.path.join(root, 'bal_w8')
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [
+      ctx.Process(
+          target=_worker,
+          args=(r, os.path.join(root, 'rdzv'), src, sink8, bal8, vocab, q))
+      for r in range(WORLD)
+  ]
+  for p in procs:
+    p.start()
+  results, errors = {}, {}
+  for _ in range(WORLD):
+    rank, err, payload = q.get(timeout=900)
+    if err is not None:
+      errors[rank] = err
+    else:
+      results[rank] = payload
+  for p in procs:
+    p.join(timeout=120)
+  assert not errors, f'worker failures: {errors}'
+  assert all(p.exitcode == 0 for p in procs)
+
+  # 1. Preprocessed partitions byte-identical to the single-process run.
+  h1, h8 = _hash_dir(sink1), _hash_dir(sink8)
+  assert h1 and h1 == h8
+
+  # 2. Balanced shards byte-identical; every rank computed the same meta.
+  assert _hash_dir(bal1) == _hash_dir(bal8)
+  for rank, (meta, _) in results.items():
+    assert meta == meta1, f'rank {rank} balance meta diverged'
+  assert load_num_samples_cache(bal1) == load_num_samples_cache(bal8)
+
+  # 3. The 8 dp ranks drained disjoint sample sets with full min-truncated
+  # per-bin coverage.
+  all_keys = [k for _, drained in results.values() for k in drained]
+  assert len(set(all_keys)) == len(all_keys), 'ranks drained overlapping rows'
+
+  paths = get_all_parquets_under(bal8)
+  expected = 0
+  for b in get_all_bin_ids(paths):
+    from lddl_tpu.core import get_file_paths_for_bin_id
+    counts = [len(read_samples(p)) for p in get_file_paths_for_bin_id(paths, b)]
+    assert len(counts) == NUM_SHARDS
+    expected += min(counts) * WORLD  # min-truncation accounting
+  assert len(all_keys) == expected
+
+  # Drained rows are real rows from the balanced shards.
+  on_disk = set()
+  for p in paths:
+    for row in read_samples(p):
+      on_disk.add((row['A'], row['B'], bool(row['is_random_next']),
+                   bytes(row['masked_lm_positions'])))
+  assert set(all_keys) <= on_disk
