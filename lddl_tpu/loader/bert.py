@@ -69,54 +69,89 @@ class BertCollate:
     self._vocab_size = tokenizer.vocab_size
 
   def __call__(self, rows, seq_len, epoch, step):
+    """Fully vectorized: no per-row Python inner loop. One id-conversion
+    call per batch, then ragged scatter via ``np.repeat``/cumsum index
+    arithmetic builds every array in whole-batch numpy ops."""
     n = len(rows)
+    arange_n = np.arange(n)
+    cols = np.arange(seq_len)
+
+    # Segment lengths without per-row splits: segments are single-space
+    # joined by the preprocess writer, so token count = space count + 1.
+    a_strs = [row['A'] for row in rows]
+    b_strs = [row['B'] for row in rows]
+    na = np.fromiter((s.count(' ') + 1 for s in a_strs), np.int64, count=n)
+    nb = np.fromiter((s.count(' ') + 1 for s in b_strs), np.int64, count=n)
+    # One conversion for the whole batch's tokens (single join + split).
+    flat_ids = np.asarray(
+        self._tok.convert_tokens_to_ids(' '.join(a_strs + b_strs).split()),
+        dtype=np.int32)
+    if flat_ids.shape[0] != int(na.sum() + nb.sum()):
+      raise AssertionError(
+          'A/B segments are not non-empty single-space-joined token '
+          'strings; shards were not written by this preprocessor')
+
+    total = na + nb + 3
+    worst = int(total.max(initial=0))
+    if worst > seq_len:
+      raise AssertionError(
+          f'sample of {worst} tokens exceeds static seq_len {seq_len}; '
+          'bin assignment or max_seq_length is inconsistent')
+
+    # Ragged destination indices: row r's A tokens land at columns
+    # [1, 1+na), its B tokens at [2+na, 2+na+nb).
+    n_a_total = int(na.sum())
+    ids_a, ids_b = flat_ids[:n_a_total], flat_ids[n_a_total:]
+    row_a = np.repeat(arange_n, na)
+    col_a = np.arange(ids_a.shape[0]) - np.repeat(np.cumsum(na) - na, na) + 1
+    row_b = np.repeat(arange_n, nb)
+    col_b = (np.arange(ids_b.shape[0]) - np.repeat(np.cumsum(nb) - nb, nb) +
+             np.repeat(2 + na, nb))
+
     input_ids = np.full((n, seq_len), self._pad_id, dtype=np.int32)
-    token_type_ids = np.zeros((n, seq_len), dtype=np.int32)
-    attention_mask = np.zeros((n, seq_len), dtype=np.int32)
-    special_mask = np.ones((n, seq_len), dtype=bool)  # pad counts as special
+    input_ids[row_a, col_a] = ids_a
+    input_ids[row_b, col_b] = ids_b
+    input_ids[:, 0] = self._cls_id
+    input_ids[arange_n, 1 + na] = self._sep_id
+    input_ids[arange_n, total - 1] = self._sep_id
+    attention_mask = (cols < total[:, None]).astype(np.int32)
+    token_type_ids = ((cols >= (2 + na)[:, None]) &
+                      (cols < total[:, None])).astype(np.int32)
+    nsp = np.fromiter((row['is_random_next'] for row in rows),
+                      np.int32, count=n)
+
     labels = np.full((n, seq_len), IGNORE_INDEX, dtype=np.int32)
-    nsp = np.zeros((n,), dtype=np.int32)
-
-    # One tokenizer call for the whole batch's tokens.
-    all_tokens = []
-    spans = []
-    for row in rows:
-      ta, tb = row['A'].split(), row['B'].split()
-      spans.append((len(ta), len(tb)))
-      all_tokens.extend(ta)
-      all_tokens.extend(tb)
-    all_ids = np.asarray(self._tok.convert_tokens_to_ids(all_tokens),
-                         dtype=np.int32)
-
-    pos = 0
-    for i, (row, (na, nb)) in enumerate(zip(rows, spans)):
-      ids_a = all_ids[pos:pos + na]
-      ids_b = all_ids[pos + na:pos + na + nb]
-      pos += na + nb
-      total = na + nb + 3
-      if total > seq_len:
+    if self._masking == 'static':
+      from ..core.utils import deserialize_np_array
+      pos_arrays = [
+          deserialize_np_array(row['masked_lm_positions']) for row in rows
+      ]
+      counts = np.fromiter((a.shape[0] for a in pos_arrays), np.int64,
+                           count=n)
+      # Validate per row (not in aggregate: offsetting mismatches across
+      # rows would silently cross-assign labels between rows).
+      label_counts = np.fromiter(
+          (row['masked_lm_labels'].count(' ') + 1 for row in rows),
+          np.int64, count=n)
+      if not np.array_equal(label_counts, counts):
+        bad = int(np.nonzero(label_counts != counts)[0][0])
         raise AssertionError(
-            f'sample of {total} tokens exceeds static seq_len {seq_len}; '
-            'bin assignment or max_seq_length is inconsistent')
-      input_ids[i, 0] = self._cls_id
-      input_ids[i, 1:1 + na] = ids_a
-      input_ids[i, 1 + na] = self._sep_id
-      input_ids[i, 2 + na:2 + na + nb] = ids_b
-      input_ids[i, total - 1] = self._sep_id
-      token_type_ids[i, 2 + na:total] = 1
-      attention_mask[i, :total] = 1
-      special_mask[i, 1:1 + na] = False
-      special_mask[i, 2 + na:2 + na + nb] = False
-      nsp[i] = int(row['is_random_next'])
-      if self._masking == 'static':
-        from ..core.utils import deserialize_np_array
-        positions = deserialize_np_array(
-            row['masked_lm_positions']).astype(np.int64)
-        label_ids = self._tok.convert_tokens_to_ids(
-            row['masked_lm_labels'].split())
-        labels[i, positions] = np.asarray(label_ids, dtype=np.int32)
-
-    if self._masking == 'dynamic':
+            f'row {bad}: {int(counts[bad])} masked_lm_positions but '
+            f'{int(label_counts[bad])} masked_lm_labels — corrupt '
+            'static-masking columns')
+      label_ids = np.asarray(
+          self._tok.convert_tokens_to_ids(
+              ' '.join(row['masked_lm_labels'] for row in rows).split()),
+          dtype=np.int32)
+      if label_ids.shape[0] != int(counts.sum()):
+        raise AssertionError(
+            'masked_lm_labels are not single-space-joined token strings')
+      labels[np.repeat(arange_n, counts),
+             np.concatenate(pos_arrays).astype(np.int64)] = label_ids
+    elif self._masking == 'dynamic':
+      special_mask = np.ones((n, seq_len), dtype=bool)  # pad/CLS/SEP stay True
+      special_mask[row_a, col_a] = False
+      special_mask[row_b, col_b] = False
       input_ids, labels = self._mask_tokens(input_ids, special_mask, epoch,
                                             step)
     return {

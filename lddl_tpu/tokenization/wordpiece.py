@@ -11,6 +11,7 @@ The facade exposes exactly what the framework needs: ``tokenize``,
 random-word masking draws), and the special tokens.
 """
 
+import operator
 import os
 
 
@@ -156,7 +157,16 @@ class BertWordPiece:
     return self._joiner
 
   def convert_tokens_to_ids(self, tokens):
-    t2i, unk = self._token_to_id, self._unk_id
+    t2i = self._token_to_id
+    # itemgetter runs the whole lookup at C speed (~2x a Python listcomp,
+    # and this is the loader collate's hottest call); fall back to the
+    # .get() path only when some token is actually out-of-vocab.
+    if len(tokens) > 1:
+      try:
+        return list(operator.itemgetter(*tokens)(t2i))
+      except KeyError:
+        pass
+    unk = self._unk_id
     return [t2i.get(t, unk) for t in tokens]
 
   def get_special_tokens_mask(self, ids):

@@ -355,3 +355,80 @@ class TestLoggerWiring:
   def test_no_log_dir_still_works(self, binned_shards, tiny_vocab):
     loader = _mk_loader(binned_shards, tiny_vocab)
     assert len(loader) == 8
+
+
+class TestCollateVectorizationParity:
+  """The vectorized BertCollate must byte-match a straightforward per-row
+  assembly (the reference recipe, ``lddl/torch/bert.py:69-149``)."""
+
+  def _rows(self, with_mask, n=23, seed=5):
+    r = random.Random(seed)
+    return [_make_sample(r, r.randrange(2), with_mask=with_mask)
+            for _ in range(n)]
+
+  def _reference_collate(self, tok, rows, seq_len, masking):
+    from lddl_tpu.core.utils import deserialize_np_array
+    n = len(rows)
+    input_ids = np.full((n, seq_len), tok.pad_token_id, dtype=np.int32)
+    token_type_ids = np.zeros((n, seq_len), dtype=np.int32)
+    attention_mask = np.zeros((n, seq_len), dtype=np.int32)
+    special = np.ones((n, seq_len), dtype=bool)
+    labels = np.full((n, seq_len), IGNORE_INDEX, dtype=np.int32)
+    nsp = np.zeros((n,), dtype=np.int32)
+    for i, row in enumerate(rows):
+      ids_a = tok.convert_tokens_to_ids(row['A'].split())
+      ids_b = tok.convert_tokens_to_ids(row['B'].split())
+      na, nb = len(ids_a), len(ids_b)
+      total = na + nb + 3
+      input_ids[i, 0] = tok.cls_token_id
+      input_ids[i, 1:1 + na] = ids_a
+      input_ids[i, 1 + na] = tok.sep_token_id
+      input_ids[i, 2 + na:2 + na + nb] = ids_b
+      input_ids[i, total - 1] = tok.sep_token_id
+      token_type_ids[i, 2 + na:total] = 1
+      attention_mask[i, :total] = 1
+      special[i, 1:1 + na] = False
+      special[i, 2 + na:2 + na + nb] = False
+      nsp[i] = int(row['is_random_next'])
+      if masking == 'static':
+        pos = deserialize_np_array(row['masked_lm_positions']).astype(
+            np.int64)
+        labels[i, pos] = np.asarray(
+            tok.convert_tokens_to_ids(row['masked_lm_labels'].split()),
+            dtype=np.int32)
+    return {
+        'input_ids': input_ids,
+        'token_type_ids': token_type_ids,
+        'attention_mask': attention_mask,
+        'labels': labels,
+        'next_sentence_labels': nsp,
+        '_special': special,
+    }
+
+  @pytest.mark.parametrize('masking', ['static', 'dynamic', 'off'])
+  def test_matches_per_row_reference(self, tiny_vocab, masking):
+    from lddl_tpu.loader.bert import BertCollate
+    from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+    tok = load_bert_tokenizer(vocab_file=tiny_vocab)
+    rows = self._rows(with_mask=(masking == 'static'))
+    collate = BertCollate(tok, masking=masking, base_seed=99, dp_rank=1)
+    got = collate(rows, seq_len=2 * BIN_SIZE, epoch=3, step=17)
+    ref = self._reference_collate(tok, rows, 2 * BIN_SIZE, masking)
+    if masking == 'dynamic':
+      # Reproduce the (already-vectorized) mask pass on the reference
+      # arrays; equality then proves the pre-mask assembly matched.
+      ref['input_ids'], ref['labels'] = collate._mask_tokens(
+          ref['input_ids'], ref['_special'], epoch=3, step=17)
+    for k in ('input_ids', 'token_type_ids', 'attention_mask', 'labels',
+              'next_sentence_labels'):
+      np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+  def test_fast_npy_deserializer_roundtrip(self):
+    from lddl_tpu.core.utils import deserialize_np_array, serialize_np_array
+    for a in (np.arange(7, dtype=np.uint16), np.zeros(0, np.uint16),
+              np.arange(5, dtype=np.int64), np.ones(3, np.float32),
+              np.arange(6, dtype=np.int32).reshape(2, 3)):
+      got = deserialize_np_array(serialize_np_array(a))
+      np.testing.assert_array_equal(got, a)
+      assert got.dtype == a.dtype
+      got[...] = 0  # must be writable, like np.load's result

@@ -30,7 +30,6 @@ from lddl_tpu.comm import FileBackend, NullBackend
 from lddl_tpu.core import get_all_bin_ids, get_all_parquets_under
 from lddl_tpu.loader import get_bert_pretrain_data_loader
 from lddl_tpu.pipeline import Executor, read_samples
-from lddl_tpu.pipeline.executor import Executor as _Executor  # noqa: F401
 from lddl_tpu.preprocess import bert
 from lddl_tpu.preprocess.readers import read_corpus
 
@@ -164,8 +163,31 @@ def test_world8_pipeline_matches_single_process(tmp_path):
   for p in procs:
     p.start()
   results, errors = {}, {}
-  for _ in range(WORLD):
-    rank, err, payload = q.get(timeout=900)
+  import queue as _queue
+  import time as _time
+  deadline = _time.monotonic() + 900
+  while len(results) + len(errors) < WORLD:
+    try:
+      rank, err, payload = q.get(timeout=5)
+    except _queue.Empty:
+      # Fail fast (with the rank named) if a worker died without reporting
+      # — e.g. OOM-killed — instead of blocking out the full timeout.
+      dead = [
+          r for r, p in enumerate(procs)
+          if p.exitcode not in (None, 0) and r not in results and
+          r not in errors
+      ]
+      if dead:
+        for p in procs:
+          p.terminate()
+        raise AssertionError(
+            f'worker rank(s) {dead} died without reporting: exitcodes '
+            f'{[procs[r].exitcode for r in dead]}')
+      if _time.monotonic() > deadline:
+        for p in procs:
+          p.terminate()
+        raise AssertionError('timed out waiting for workers')
+      continue
     if err is not None:
       errors[rank] = err
     else:
