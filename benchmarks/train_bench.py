@@ -174,7 +174,90 @@ def build_train_state(args, tokenizer):
   opt_state = jax.jit(
       tx.init, out_shardings=None)(params)
   step = make_train_step(model, tx, mesh)
-  return cfg, mesh, step, params, opt_state
+  return cfg, mesh, model, tx, step, params, opt_state
+
+
+def run_scan(args, loader, tokenizer):
+  """``--scan-steps K``: jit K train steps into ONE program (``lax.scan``
+  over a device-resident batch window) so per-step dispatch cost
+  amortizes — the MFU measurement mode for dispatch-latency-bound links
+  (a tunneled chip pays tens of ms per program launch; at K=16 that floor
+  shrinks 16x). Collects K same-shape batches from the real loader,
+  stacks them on device, then times ``--scan-windows`` window executions.
+  """
+  import jax
+
+  from lddl_tpu.models.flops import (bert_pretrain_flops_per_step,
+                                     peak_flops_per_device)
+  from lddl_tpu.parallel import make_scan_train_step, stack_batch_window
+
+  cfg, mesh, model, tx, _, params, opt_state = build_train_state(
+      args, tokenizer)
+  k = args.scan_steps
+  # K batches of one static shape (whichever bin shape fills first wins).
+  by_shape = {}
+  batches = None
+  for batch in loader:
+    check_batch(batch)
+    group = by_shape.setdefault(batch['input_ids'].shape, [])
+    group.append(batch)
+    if len(group) == k:
+      batches = group
+      break
+  if batches is None:
+    best = max(by_shape.values(), key=len, default=[])
+    raise SystemExit(
+        f'no bin yielded {k} batches (best: {len(best)}); lower '
+        '--scan-steps or use a bigger dataset')
+  shape = batches[0]['input_ids'].shape
+  window = stack_batch_window(batches, mesh)
+  b, s = shape
+  scan = make_scan_train_step(model, tx, mesh)
+  rng = jax.random.key(args.seed + 1)
+
+  t0 = time.perf_counter()
+  params, opt_state, metrics = scan(params, opt_state, rng, window)
+  # Synchronize via a device->host value transfer: on the experimental
+  # axon (tunneled-chip) platform block_until_ready has been observed to
+  # return before execution finishes, which would time a window at ~0.
+  loss = float(metrics['loss'])
+  compile_s = time.perf_counter() - t0
+
+  n_dev = len(jax.devices())
+  peak = (args.peak_tflops * 1e12 if args.peak_tflops else
+          peak_flops_per_device())
+  flops_per_step = bert_pretrain_flops_per_step(cfg, b, s)
+  times = []
+  for _ in range(args.scan_windows):
+    t0 = time.perf_counter()
+    params, opt_state, metrics = scan(params, opt_state, rng, window)
+    loss = float(metrics['loss'])
+    times.append(time.perf_counter() - t0)
+  # Median window: robust against tunnel-jitter outliers in either
+  # direction (slow links stall; a too-fast sample means a sync anomaly).
+  med_step = sorted(times)[len(times) // 2] / k
+  avg_step = sum(times) / len(times) / k
+  summary = {
+      'mode': 'train-scan',
+      'model': args.model,
+      'batch': b,
+      'seq_len': s,
+      'scan_steps': k,
+      'windows': args.scan_windows,
+      'compile_seconds': round(compile_s, 2),
+      'avg_latency_ms': round(avg_step * 1e3, 3),
+      'median_latency_ms': round(med_step * 1e3, 3),
+      'min_latency_ms': round(min(times) / k * 1e3, 3),
+      'samples_per_sec': round(b / med_step, 2),
+      'tokens_per_sec': round(b * s / med_step, 1),
+      'model_tflops_per_sec': round(flops_per_step / med_step / 1e12, 3),
+      'mfu': round(flops_per_step / med_step / (peak * n_dev), 6),
+      'remat': bool(args.remat),
+      'devices': n_dev,
+      'loss': round(loss, 4),
+  }
+  print(json.dumps(summary))
+  return summary
 
 
 def run(args):
@@ -212,6 +295,9 @@ def run(args):
       log_dir=args.log_dir,
       log_level=getattr(logging, args.log_level))
 
+  if args.mode == 'train' and args.scan_steps:
+    return run_scan(args, loader, tokenizer)
+
   iters_per_epoch = min(len(loader), args.iters_per_epoch)
   stats = SeqlenStats(args.epochs, iters_per_epoch)
   meter = StepMeter(warmup=args.warmup)
@@ -224,7 +310,8 @@ def run(args):
     from lddl_tpu.loader.device import prefetch_to_device
     from lddl_tpu.models.flops import (bert_pretrain_flops_per_step,
                                        peak_flops_per_device)
-    cfg, mesh, step, params, opt_state = build_train_state(args, tokenizer)
+    cfg, mesh, _, _, step, params, opt_state = build_train_state(
+        args, tokenizer)
     rng = jax.random.key(args.seed + 1)
     peak = (args.peak_tflops * 1e12 if args.peak_tflops else
             peak_flops_per_device())
@@ -386,6 +473,13 @@ def attach_args(parser):
   parser.add_argument('--tp', type=int, default=1)
   parser.add_argument('--sp', type=int, default=1)
   parser.add_argument('--prefetch', type=int, default=2)
+  parser.add_argument('--scan-steps', type=int, default=0,
+                      help='train mode: jit this many steps into one '
+                           'program (lax.scan over a device-resident '
+                           'window) so dispatch cost amortizes; 0 = '
+                           'one program per step')
+  parser.add_argument('--scan-windows', type=int, default=8,
+                      help='timed window executions in --scan-steps mode')
   parser.add_argument('--peak-tflops', type=float, default=None,
                       help='override per-chip peak bf16 TFLOP/s for MFU')
   parser.add_argument('--remat', action='store_true',

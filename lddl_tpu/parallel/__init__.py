@@ -11,10 +11,11 @@ collectives over ICI, and ring attention for long-context scaling.
 from .mesh import (MESH_AXES, batch_pspec, canonical_batch_spec, make_mesh,
                    mesh_summary)
 from .ring import ring_attention
-from .train import init_params, make_train_step, shard_batch
+from .train import (init_params, make_scan_train_step, make_train_step,
+                    shard_batch, stack_batch_window)
 
 __all__ = [
     'MESH_AXES', 'batch_pspec', 'canonical_batch_spec', 'make_mesh',
     'mesh_summary', 'ring_attention', 'init_params', 'make_train_step',
-    'shard_batch'
+    'make_scan_train_step', 'shard_batch', 'stack_batch_window'
 ]
