@@ -1,0 +1,152 @@
+"""Preprocess wall-clock vs world size over FileBackend processes.
+
+Makes the "embarrassingly parallel" claim inspectable (PERF.md): run the
+identical preprocess (same corpus, same config, same partition count) at
+world sizes 1/2/4/8 — N OS processes rendezvousing over a shared
+filesystem, the reference's multi-node pattern
+(``/root/reference/examples/slurm_example.sub:70-118``) in miniature —
+and report each run's wall-clock plus a byte-equality check of the output
+against the world-1 run.
+
+On a multi-core host the expected shape is ~linear speedup until the
+writer/disk saturates; on a 1-vCPU box (this one) aggregate stays ~1x —
+the table still demonstrates that world size changes only the wall-clock,
+never the bytes.
+
+Prints one JSON line per world size:
+  {"world": N, "wall_seconds": S, "mb_per_sec": R, "identical": true}
+
+Usage: python benchmarks/scale_out_bench.py [--mb 16] [--worlds 1 2 4 8]
+"""
+
+import argparse
+import hashlib
+import json
+import multiprocessing as mp
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_VOCAB = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'assets',
+                      'bench_vocab_30522.txt')
+NUM_BLOCKS = 16
+
+
+def _config(seed=42):
+  from lddl_tpu.preprocess.bert import BertPretrainConfig
+  return BertPretrainConfig(
+      vocab_file=_VOCAB,
+      target_seq_length=128,
+      bin_size=32,
+      duplicate_factor=1,
+      masking=True,
+      sentence_backend='rules',
+      seed=seed,
+      engine='fast',
+      tokenizer_backend='native',
+      mask_backend='host')
+
+
+def _worker(rank, world, rdzv, src, sink, q):
+  from lddl_tpu.comm import FileBackend, NullBackend
+  from lddl_tpu.pipeline.executor import Executor
+  from lddl_tpu.preprocess.bert import run
+  from lddl_tpu.preprocess.readers import read_corpus
+
+  comm = (NullBackend() if world == 1 else FileBackend(
+      rdzv, rank, world, timeout=600.0))
+  executor = Executor(comm=comm, num_local_workers=1)
+  corpus = read_corpus([src], num_blocks=NUM_BLOCKS, sample_ratio=1.0)
+  # Time from the post-warmup barrier so process startup/imports (which a
+  # long real run amortizes) stay out of the measured window.
+  from lddl_tpu.preprocess.bert import _get_tokenizer
+  _get_tokenizer(_config()).batch_tokenize(['warm up'])
+  comm.barrier()
+  t0 = time.perf_counter()
+  run(corpus, sink, _config(), executor=executor,
+      num_shuffle_partitions=NUM_BLOCKS)
+  comm.barrier()
+  elapsed = time.perf_counter() - t0
+  q.put((rank, elapsed))
+
+
+def _hash_dir(d):
+  from lddl_tpu.core.utils import get_all_parquets_under
+  out = {}
+  for p in get_all_parquets_under(d):
+    with open(p, 'rb') as f:
+      out[os.path.basename(p)] = hashlib.sha256(f.read()).hexdigest()
+  return out
+
+
+def main(argv=None):
+  ap = argparse.ArgumentParser(description=__doc__)
+  ap.add_argument('--mb', type=float, default=16.0)
+  ap.add_argument('--worlds', type=int, nargs='+', default=[1, 2, 4, 8])
+  args = ap.parse_args(argv)
+
+  work = tempfile.mkdtemp(prefix='lddl_scaleout_')
+  try:
+    from lddl_tpu.core.synth import write_corpus
+    src = os.path.join(work, 'src')
+    actual_mb = write_corpus(src, args.mb, num_shards=8, seed=1234)
+    print(f'# corpus: {actual_mb:.1f} MB, {NUM_BLOCKS} partitions, '
+          f'{os.cpu_count()} host core(s)', flush=True)
+
+    ctx = mp.get_context('spawn')
+    ref_hashes = None
+    for world in args.worlds:
+      sink = os.path.join(work, f'sink_w{world}')
+      rdzv = os.path.join(work, f'rdzv_w{world}')
+      q = ctx.Queue()
+      procs = [
+          ctx.Process(target=_worker, args=(r, world, rdzv, src, sink, q))
+          for r in range(world)
+      ]
+      t0 = time.perf_counter()
+      for p in procs:
+        p.start()
+      times = []
+      import queue as _queue
+      deadline = time.monotonic() + 1200
+      while len(times) < world:
+        try:
+          times.append(q.get(timeout=5)[1])
+        except _queue.Empty:
+          # Fail fast, naming the rank, if a worker died before reporting.
+          dead = [r for r, p in enumerate(procs)
+                  if p.exitcode not in (None, 0)]
+          if dead:
+            for p in procs:
+              p.terminate()
+            raise SystemExit(
+                f'worker rank(s) {dead} died: exitcodes '
+                f'{[procs[r].exitcode for r in dead]}')
+          if time.monotonic() > deadline:
+            for p in procs:
+              p.terminate()
+            raise SystemExit('timed out waiting for workers')
+      for p in procs:
+        p.join(timeout=120)
+        assert p.exitcode == 0, p.exitcode
+      wall = max(times)
+      hashes = _hash_dir(sink)
+      if ref_hashes is None:
+        ref_hashes = hashes
+      print(json.dumps({
+          'world': world,
+          'wall_seconds': round(wall, 2),
+          'mb_per_sec': round(actual_mb / wall, 3),
+          'identical': hashes == ref_hashes,
+      }), flush=True)
+      shutil.rmtree(sink, ignore_errors=True)
+  finally:
+    shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == '__main__':
+  main()

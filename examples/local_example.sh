@@ -38,13 +38,13 @@ mkdir -p "${workdir}"
 echo '== 1/5 generate a synthetic one-document-per-line corpus + vocab'
 python - "$workdir" <<'EOF'
 import sys, os
-repo_work = sys.argv[1]
-sys.path.insert(0, os.environ['PYTHONPATH'].split(':')[0])
-from bench import _build_vocab, _gen_corpus
-_build_vocab(os.path.join(repo_work, 'vocab.txt'))
-mb = _gen_corpus(os.path.join(repo_work, 'source'), 2)
-print(f'generated {mb:.1f} MB under {repo_work}/source')
+workdir = sys.argv[1]
+from lddl_tpu.core.synth import write_corpus
+mb = write_corpus(os.path.join(workdir, 'source'), 2, num_shards=4,
+                  seed=1234)
+print(f'generated {mb:.1f} MB under {workdir}/source')
 EOF
+cp "${repo}/benchmarks/assets/bench_vocab_30522.txt" "${workdir}/vocab.txt"
 
 echo '== 2/5 preprocess (static masking + sequence binning)'
 python -m lddl_tpu.cli preprocess_bert_pretrain \
