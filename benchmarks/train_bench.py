@@ -282,7 +282,12 @@ def run(args):
       dp_rank=args.dp_rank,
       dp_world_size=args.dp_world_size,
       batch_size_per_rank=args.batch_size,
-      tokenizer=tokenizer,
+      # Worker processes rebuild the tokenizer from the file/name args; a
+      # live tokenizer is only passed for the in-process path.
+      tokenizer=None if args.num_workers else tokenizer,
+      vocab_file=args.vocab_file,
+      tokenizer_name=args.tokenizer,
+      num_workers=args.num_workers,
       masking=args.masking,
       mlm_probability=args.mlm_probability,
       max_seq_length=args.max_seq_length,
@@ -450,6 +455,9 @@ def attach_args(parser):
   parser.add_argument('--warmup', type=int, default=2,
                       help='steps excluded from latency aggregates '
                            '(compile steps)')
+  parser.add_argument('--num-workers', type=int, default=0,
+                      help='collate in this many worker processes '
+                           '(byte-identical output; 0 = in-process)')
   parser.add_argument('--shuffle-buffer-size', type=int, default=16384)
   parser.add_argument('--shuffle-buffer-warmup-factor', type=int, default=16)
   parser.add_argument('--seed', type=int, default=127)

@@ -250,7 +250,19 @@ class BertPretrainLoader:
     self._batches_consumed = 0
     return it
 
-  def __iter__(self):
+  def iter_steps(self, step_shard=(0, 1)):
+    """Yield ``(step, batch)`` for this epoch, collating only the steps of
+    this shard.
+
+    ``step_shard=(w, W)`` advances the FULL deterministic row stream (the
+    shuffle-buffer sequence is position-dependent, so every worker must
+    replay it identically) but runs the expensive collate only for steps
+    with ``step % W == w`` — the unit of within-rank worker parallelism
+    (:mod:`lddl_tpu.loader.workers`). Unlike the reference's per-worker
+    file sharding (``torch/datasets.py:272``, which changes batch
+    composition with the worker count), sharding by step index keeps the
+    produced batches byte-identical for every W.
+    """
     # Capture the resume offset before _make_iterator() clears it: the
     # collate step counter must continue from where the interrupted run
     # stopped, or dynamic-mask Philox keys (keyed on step) would diverge
@@ -258,13 +270,19 @@ class BertPretrainLoader:
     consumed = self._batches_consumed
     it = self._make_iterator()
     epoch = self.epoch
+    w, num_shards = step_shard
     for step, (bin_idx, rows) in enumerate(it, start=consumed):
+      if step % num_shards != w:
+        continue
       batch = self._collate(rows, self._seqlen_of_bin(bin_idx), epoch, step)
       if self._micro is not None:
-        yield split_into_micro_batches(batch, self._micro)
-      else:
-        yield batch
+        batch = split_into_micro_batches(batch, self._micro)
+      yield step, batch
     self.epoch += 1
+
+  def __iter__(self):
+    for _, batch in self.iter_steps():
+      yield batch
 
 
 def build_pretrain_loader(
@@ -386,6 +404,7 @@ def get_bert_pretrain_data_loader(
     log_dir=None,
     log_level=None,
     return_raw_samples=False,
+    num_workers=0,
 ):
   """Build the BERT pretraining loader over a balanced shard directory.
 
@@ -397,7 +416,19 @@ def get_bert_pretrain_data_loader(
   ``return_raw_samples``: yield the raw row dicts (lists per batch)
   instead of collated arrays — the reference's debug/eyeballing mode
   (``torch/bert.py:253``).
+  ``num_workers``: collate in this many worker processes (reference
+  ``torch/bert.py:382-386``); output batches are byte-identical to
+  ``num_workers=0`` — see :mod:`lddl_tpu.loader.workers`. Requires
+  ``vocab_file``/``tokenizer_name`` (not a live ``tokenizer``).
   """
+  if num_workers:
+    # locals() here holds exactly this function's parameters (this block
+    # is the first statement), so a future parameter cannot be silently
+    # dropped from the worker rebuild — that would break the documented
+    # byte-identity between num_workers=0 and >0.
+    build_kwargs = {k: v for k, v in locals().items() if k != 'num_workers'}
+    from .workers import MultiprocessLoader
+    return MultiprocessLoader(build_kwargs, num_workers)
   if return_raw_samples:
     collate = lambda rows, seq_len, epoch, step: rows
     return build_pretrain_loader(

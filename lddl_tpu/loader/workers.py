@@ -1,0 +1,154 @@
+"""Within-rank worker-process loading.
+
+Capability parity with the reference's N-DataLoader-workers-per-rank
+overlap (``lddl/torch/bert.py:382-386`` persistent workers,
+``torch/datasets.py:271-272`` per-worker file sharding) with one
+deliberate improvement: workers shard the *deterministic step sequence*
+(``step % W == w``) instead of the file list, so every worker replays the
+identical cheap row stream but collates only its own steps — the batches
+a rank sees are **byte-identical for every worker count** (the
+reference's file sharding changes batch composition when ``num_workers``
+changes).
+
+Because step ownership is static, the parent needs no reorder buffer:
+step ``s`` always arrives on worker ``s % W``'s own queue, so pulling the
+queues round-robin yields the exact serial order with per-worker
+backpressure (each worker can run at most ``queue maxsize`` steps ahead —
+bounded memory by construction).
+
+The expensive work (the collate: ragged scatter, id conversion, mask
+drawing) parallelizes across W processes; the replayed bookkeeping
+(shuffle-buffer row stream) is duplicated per worker but is an order of
+magnitude cheaper than collate.
+"""
+
+import multiprocessing as _mp
+import queue as _queue
+import sys
+import traceback
+
+
+def _mp_context():
+  """forkserver/spawn once jax is loaded (forking a live JAX runtime can
+  deadlock the child — same rule as the pipeline executor); fork
+  otherwise for cheap startup."""
+  if 'jax' in sys.modules and 'forkserver' in _mp.get_all_start_methods():
+    return _mp.get_context('forkserver')
+  if 'jax' in sys.modules:
+    return _mp.get_context('spawn')
+  return _mp.get_context()
+
+
+def _worker_main(build_kwargs, epoch, clear_consumed, w, num_workers, q):
+  try:
+    from .bert import get_bert_pretrain_data_loader
+    loader = get_bert_pretrain_data_loader(**build_kwargs)
+    loader.epoch = epoch
+    if clear_consumed:
+      loader._batches_consumed = 0
+    for step, batch in loader.iter_steps((w, num_workers)):
+      q.put(('batch', step, batch))
+    q.put(('done', w, None))
+  except BaseException:
+    q.put(('error', w, traceback.format_exc()))
+    raise
+
+
+class MultiprocessLoader:
+  """Drop-in epoch-iterable: ``W`` worker processes collate in parallel,
+  batches arrive in exact serial order.
+
+  ``build_kwargs`` must reconstruct the serial loader in a fresh process
+  (so pass ``vocab_file``/``tokenizer_name``, not a live tokenizer
+  object). The serial loader built in-process serves metadata
+  (``__len__``, ``samples_per_epoch``) and tracks epoch/resume state.
+  """
+
+  def __init__(self, build_kwargs, num_workers):
+    from ..comm import NullBackend
+    from .bert import get_bert_pretrain_data_loader
+    if build_kwargs.get('tokenizer') is not None:
+      raise ValueError(
+          'num_workers > 0 requires vocab_file/tokenizer_name (worker '
+          'processes must reconstruct the tokenizer; a live tokenizer '
+          'object does not pickle)')
+    self._kwargs = dict(build_kwargs)
+    # Workers must NOT participate in comm collectives: they would rejoin
+    # the world as duplicate ranks and corrupt the real ranks' collective
+    # sequence. An explicit NullBackend (not None — build_pretrain_loader
+    # resolves None through get_backend()/LDDL_COMM, which workers
+    # inherit) keeps them local; balanced dirs carry .num_samples.json so
+    # metadata needs no collective, and a cache miss just counts locally.
+    self._kwargs['comm'] = NullBackend()
+    self._num_workers = num_workers
+    self._serial = get_bert_pretrain_data_loader(**build_kwargs)
+
+  def __len__(self):
+    return len(self._serial)
+
+  @property
+  def samples_per_epoch(self):
+    return self._serial.samples_per_epoch
+
+  @property
+  def epoch(self):
+    return self._serial.epoch
+
+  @epoch.setter
+  def epoch(self, value):
+    self._serial.epoch = value
+
+  def _get(self, q, proc, w):
+    """Queue get that fails fast (naming the worker) on a dead producer
+    instead of blocking forever — a hard-killed worker sends no
+    sentinel."""
+    while True:
+      try:
+        return q.get(timeout=5)
+      except _queue.Empty:
+        if not proc.is_alive():
+          raise RuntimeError(
+              f'loader worker {w} died without reporting '
+              f'(exitcode {proc.exitcode})')
+
+  def __iter__(self):
+    epoch = self._serial.epoch
+    first_step = self._serial._batches_consumed
+    clear_consumed = first_step == 0
+    # Mirror the serial loader exactly: it clears the resume offset the
+    # moment an iteration starts (bert.py _make_iterator), so len() of an
+    # abandoned-then-restarted epoch reports the full count either way.
+    self._serial._batches_consumed = 0
+    ctx = _mp_context()
+    queues = [ctx.Queue(maxsize=4) for _ in range(self._num_workers)]
+    procs = [
+        ctx.Process(
+            target=_worker_main,
+            args=(self._kwargs, epoch, clear_consumed, w, self._num_workers,
+                  queues[w]),
+            daemon=True) for w in range(self._num_workers)
+    ]
+    for p in procs:
+      p.start()
+    step = first_step
+    try:
+      while True:
+        w = step % self._num_workers
+        kind, a, b = self._get(queues[w], procs[w], w)
+        if kind == 'batch':
+          assert a == step, f'worker {w} sent step {a}, expected {step}'
+          yield b
+          step += 1
+        elif kind == 'done':
+          # Worker w owns step `step`; it having nothing >= `step` means
+          # no worker has any step >= `step` — the epoch is complete.
+          break
+        else:
+          raise RuntimeError(f'loader worker {a} failed:\n{b}')
+      self._serial.epoch = epoch + 1
+    finally:
+      for p in procs:
+        if p.is_alive():
+          p.terminate()
+      for p in procs:
+        p.join(timeout=30)
