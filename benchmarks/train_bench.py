@@ -162,6 +162,7 @@ def build_train_state(args, tokenizer):
       num_heads=heads,
       intermediate_size=inter,
       max_position_embeddings=max(args.max_seq_length, 512),
+      attention_impl=args.attention,
       remat=args.remat)
   model = BertForPretraining(cfg)
   mesh = make_mesh(data=args.dp, fsdp=args.fsdp, tensor=args.tp,
@@ -173,7 +174,8 @@ def build_train_state(args, tokenizer):
                        seq_len=min(128, args.max_seq_length))
   opt_state = jax.jit(
       tx.init, out_shardings=None)(params)
-  step = make_train_step(model, tx, mesh)
+  step = make_train_step(model, tx, mesh,
+                         max_predictions=args.max_predictions)
   return cfg, mesh, model, tx, step, params, opt_state
 
 
@@ -212,7 +214,8 @@ def run_scan(args, loader, tokenizer):
   shape = batches[0]['input_ids'].shape
   window = stack_batch_window(batches, mesh)
   b, s = shape
-  scan = make_scan_train_step(model, tx, mesh)
+  scan = make_scan_train_step(model, tx, mesh,
+                              max_predictions=args.max_predictions)
   rng = jax.random.key(args.seed + 1)
 
   t0 = time.perf_counter()
@@ -226,7 +229,8 @@ def run_scan(args, loader, tokenizer):
   n_dev = len(jax.devices())
   peak = (args.peak_tflops * 1e12 if args.peak_tflops else
           peak_flops_per_device())
-  flops_per_step = bert_pretrain_flops_per_step(cfg, b, s)
+  flops_per_step = bert_pretrain_flops_per_step(
+      cfg, b, s, max_predictions=args.max_predictions)
   times = []
   for _ in range(args.scan_windows):
     t0 = time.perf_counter()
@@ -356,7 +360,8 @@ def run(args):
         params, opt_state, metrics = step(params, opt_state, rng, batch)
         jax.block_until_ready(metrics['loss'])
         b, s = batch['input_ids'].shape
-        total_model_flops += bert_pretrain_flops_per_step(cfg, b, s)
+        total_model_flops += bert_pretrain_flops_per_step(
+            cfg, b, s, max_predictions=args.max_predictions)
       else:
         t_data = time.perf_counter()
         try:
@@ -490,6 +495,15 @@ def attach_args(parser):
                       help='timed window executions in --scan-steps mode')
   parser.add_argument('--peak-tflops', type=float, default=None,
                       help='override per-chip peak bf16 TFLOP/s for MFU')
+  parser.add_argument('--attention', default='dense',
+                      choices=['dense', 'flash', 'ring', 'ring_flash'],
+                      help='attention implementation (flash: Pallas '
+                           'blockwise kernel, no s^2 score tensor)')
+  parser.add_argument('--max-predictions', type=int, default=None,
+                      help='masked-only MLM head: gather this many MLM '
+                           'positions per row before the vocab projection '
+                           '(honest FLOPs accounting follows); None = '
+                           'full-sequence head')
   parser.add_argument('--remat', action='store_true',
                       help='rematerialize layer activations (trade FLOPs '
                            'for HBM; lets bigger batches fit)')

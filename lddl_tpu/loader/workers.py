@@ -91,6 +91,11 @@ class MultiprocessLoader:
     return self._serial.samples_per_epoch
 
   @property
+  def _batch(self):
+    # Per-rank batch size; TrainLoop reads this off the serial loader.
+    return self._serial._batch
+
+  @property
   def epoch(self):
     return self._serial.epoch
 

@@ -173,8 +173,18 @@ class BertForPretraining(nn.Module):
                                (cfg.vocab_size,), jnp.float32)
 
   def __call__(self, input_ids, token_type_ids, attention_mask,
-               deterministic=True):
-    """Returns (mlm_logits [b,s,V] float32, nsp_logits [b,2] float32)."""
+               deterministic=True, mlm_positions=None):
+    """Returns (mlm_logits float32, nsp_logits [b,2] float32).
+
+    ``mlm_positions=None``: logits over every position, ``[b, s, V]``.
+    ``mlm_positions`` int32 ``[b, P]``: the masked-only head — hidden
+    states are gathered at those positions *before* the transform and
+    tied vocab projection, so logits are ``[b, P, V]``. With P = the
+    static masking budget (~0.15·s) this removes the dominant
+    ``b·s·V`` logits chain from compute and HBM (only ~15% of positions
+    carry MLM targets); the classic BERT-pretraining optimization,
+    expressed with the static shapes XLA wants.
+    """
     cfg = self.cfg
     s = input_ids.shape[1]
     pos = jnp.arange(s, dtype=jnp.int32)[None, :]
@@ -184,7 +194,10 @@ class BertForPretraining(nn.Module):
     mask = attention_mask.astype(bool)
     x = self.encoder(x, mask, deterministic)
 
-    h = self.mlm_norm(nn.gelu(self.mlm_transform(x), approximate=True))
+    x_mlm = x
+    if mlm_positions is not None:
+      x_mlm = jnp.take_along_axis(x, mlm_positions[:, :, None], axis=1)
+    h = self.mlm_norm(nn.gelu(self.mlm_transform(x_mlm), approximate=True))
     mlm_logits = (self.word_embeddings.attend(h).astype(jnp.float32) +
                   self.mlm_bias)
     pooled = jnp.tanh(self.pooler(x[:, 0]))

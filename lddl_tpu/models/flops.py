@@ -63,16 +63,23 @@ def bert_encoder_flops(cfg, batch, seq_len):
   return cfg.num_layers * per_layer
 
 
-def bert_pretrain_flops_per_step(cfg, batch, seq_len):
+def bert_pretrain_flops_per_step(cfg, batch, seq_len, max_predictions=None):
   """Total matmul FLOPs of one pretraining train step (fwd + bwd).
 
-  Head terms: MLM transform d², tied decoder d·V over every position,
-  pooler+NSP ≈ 2·B·d². Backward pass costs 2× forward; optimizer update
-  FLOPs are vector ops, negligible next to the matmuls.
+  Head terms: MLM transform d², tied decoder d·V — over every position
+  for the full head, or over ``max_predictions`` gathered positions for
+  the masked-only head (the accounting must match what the model
+  actually computes, so the masked-only mode reports its honestly
+  smaller numerator). Pooler+NSP ≈ 2·B·d². Backward pass costs 2×
+  forward; optimizer update FLOPs are vector ops, negligible next to the
+  matmuls.
   """
   b, s, d = batch, seq_len, cfg.hidden_size
+  # Clamp to s: the loss slices its position gather to at most s, so
+  # billing more would inflate the numerator.
+  head_positions = s if max_predictions is None else min(max_predictions, s)
   fwd = bert_encoder_flops(cfg, batch, seq_len)
-  fwd += 2 * b * s * d * d                    # MLM transform
-  fwd += 2 * b * s * d * cfg.vocab_size       # tied decoder
+  fwd += 2 * b * head_positions * d * d               # MLM transform
+  fwd += 2 * b * head_positions * d * cfg.vocab_size  # tied decoder
   fwd += 2 * b * d * d                        # pooler (NSP head is d x 2)
   return 3 * fwd

@@ -55,18 +55,41 @@ def init_params(model, mesh, rng, seq_len=128, batch=2):
   return jax.jit(init_fn, out_shardings=shardings)()
 
 
-def pretrain_loss(model, params, batch, dropout_rng=None):
-  """Scalar loss + metrics dict for one batch."""
+def pretrain_loss(model, params, batch, dropout_rng=None,
+                  max_predictions=None):
+  """Scalar loss + metrics dict for one batch.
+
+  ``max_predictions=P`` selects the masked-only MLM head: the first P
+  masked positions per row are gathered and only their ``[b, P, V]``
+  logits are computed — numerically the same MLM cross entropy (CE is
+  only ever evaluated at masked positions), at a fraction of the head
+  FLOPs/HBM. Choose P at least the masking budget: static masking caps
+  predictions at round(s·ratio)(+cap) so any P >= that bound is exact;
+  dynamic masking is Bernoulli per position, so rows in the far binomial
+  tail (> P masked) would silently drop their overflow targets — size P
+  with headroom there.
+  """
   deterministic = dropout_rng is None
   rngs = None if deterministic else {'dropout': dropout_rng}
+  labels = batch['labels']
+  mlm_positions = None
+  if max_predictions is not None:
+    # The first P masked column indices per row, padded with arbitrary
+    # unmasked columns whose gathered labels are IGNORE_INDEX (stable
+    # argsort of the ~masked bitmap = masked columns first, in order).
+    p = min(max_predictions, labels.shape[1])
+    mlm_positions = jnp.argsort(
+        labels == IGNORE_INDEX, axis=1, stable=True,
+    )[:, :p].astype(jnp.int32)
+    labels = jnp.take_along_axis(labels, mlm_positions, axis=1)
   mlm_logits, nsp_logits = model.apply(
       {'params': params},
       batch['input_ids'],
       batch['token_type_ids'],
       batch['attention_mask'],
       deterministic=deterministic,
+      mlm_positions=mlm_positions,
       rngs=rngs)
-  labels = batch['labels']
   masked = labels != IGNORE_INDEX
   safe_labels = jnp.where(masked, labels, 0)
   mlm_ce = optax.softmax_cross_entropy_with_integer_labels(
@@ -84,7 +107,8 @@ def pretrain_loss(model, params, batch, dropout_rng=None):
   }
 
 
-def _train_step_body(model, tx, params, opt_state, rng, batch):
+def _train_step_body(model, tx, params, opt_state, rng, batch,
+                     max_predictions=None):
   """One un-jitted train step — the single definition both
   :func:`make_train_step` and :func:`make_scan_train_step` compile, so the
   per-step and scan-window paths stay provably identical."""
@@ -92,7 +116,8 @@ def _train_step_body(model, tx, params, opt_state, rng, batch):
       rng, opt_state[0].count if hasattr(opt_state[0], 'count') else 0)
 
   def loss_fn(p):
-    return pretrain_loss(model, p, batch, dropout_rng=rng)
+    return pretrain_loss(model, p, batch, dropout_rng=rng,
+                         max_predictions=max_predictions)
 
   (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
   updates, opt_state = tx.update(grads, opt_state, params)
@@ -101,7 +126,7 @@ def _train_step_body(model, tx, params, opt_state, rng, batch):
   return params, opt_state, metrics
 
 
-def make_train_step(model, tx, mesh):
+def make_train_step(model, tx, mesh, max_predictions=None):
   """Returns ``step(params, opt_state, rng, batch) ->
   (params, opt_state, metrics)``, jitted with donated state.
 
@@ -109,16 +134,19 @@ def make_train_step(model, tx, mesh):
   device pipeline does this); params carry their own shardings from
   :func:`init_params`, so jit needs no in_shardings — placement is taken
   from the arguments and GSPMD inserts every collective.
+  ``max_predictions`` selects the masked-only MLM head (see
+  :func:`pretrain_loss`).
   """
 
   @functools.partial(jax.jit, donate_argnums=(0, 1))
   def step(params, opt_state, rng, batch):
-    return _train_step_body(model, tx, params, opt_state, rng, batch)
+    return _train_step_body(model, tx, params, opt_state, rng, batch,
+                            max_predictions)
 
   return step
 
 
-def make_scan_train_step(model, tx, mesh):
+def make_scan_train_step(model, tx, mesh, max_predictions=None):
   """Returns ``run(params, opt_state, rng, batches) ->
   (params, opt_state, last_metrics)`` where every array in ``batches``
   carries a leading steps axis: one compiled program executes the whole
@@ -138,7 +166,8 @@ def make_scan_train_step(model, tx, mesh):
 
     def body(carry, batch):
       params, opt_state, metrics = _train_step_body(model, tx, carry[0],
-                                                    carry[1], rng, batch)
+                                                    carry[1], rng, batch,
+                                                    max_predictions)
       return (params, opt_state), metrics
 
     (params, opt_state), metrics = jax.lax.scan(body, (params, opt_state),

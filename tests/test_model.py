@@ -154,3 +154,62 @@ class TestBertModel:
     out_r = ring_model.apply({'params': params}, ids, tt, am)
     np.testing.assert_allclose(
         np.asarray(out_d[0]), np.asarray(out_r[0]), rtol=2e-3, atol=2e-3)
+
+
+class TestMaskedOnlyHead:
+  """The masked-only MLM head must reproduce the full head's loss exactly
+  (CE is only ever evaluated at masked positions) whenever P covers every
+  row's masked count, and the accounting must bill the smaller head."""
+
+  def _batch(self, b=4, s=32, max_masked=4, seed=3):
+    rng = np.random.default_rng(seed)
+    batch = {
+        'input_ids': rng.integers(0, 64, (b, s)).astype(np.int32),
+        'token_type_ids': np.zeros((b, s), np.int32),
+        'attention_mask': np.ones((b, s), np.int32),
+        'labels': np.full((b, s), -100, np.int32),
+        'next_sentence_labels': rng.integers(0, 2, (b,)).astype(np.int32),
+    }
+    for i in range(b):
+      cols = rng.choice(np.arange(1, s - 1), size=rng.integers(1, max_masked + 1),
+                        replace=False)
+      batch['labels'][i, cols] = rng.integers(0, 64, len(cols))
+    return batch
+
+  def test_loss_matches_full_head(self):
+    mesh = make_mesh(data=1, fsdp=1, tensor=1, seq=1,
+                     devices=jax.devices()[:1])
+    model = BertForPretraining(TINY)
+    params = init_params(model, mesh, jax.random.key(0), seq_len=32)
+    batch = shard_batch(self._batch(), mesh)
+    full, m_full = jax.jit(
+        lambda p, bt: pretrain_loss(model, p, bt))(params, batch)
+    gathered, m_gath = jax.jit(
+        lambda p, bt: pretrain_loss(model, p, bt, max_predictions=6))(
+            params, batch)
+    np.testing.assert_allclose(float(full), float(gathered), rtol=1e-6)
+    np.testing.assert_allclose(float(m_full['mlm_acc']),
+                               float(m_gath['mlm_acc']), rtol=1e-6)
+
+  def test_train_step_with_masked_only_head(self):
+    mesh = make_mesh(data=1, fsdp=1, tensor=1, seq=1,
+                     devices=jax.devices()[:1])
+    model = BertForPretraining(TINY)
+    params = init_params(model, mesh, jax.random.key(0), seq_len=32)
+    tx = optax.adamw(1e-3)
+    opt_state = tx.init(params)
+    step = make_train_step(model, tx, mesh, max_predictions=6)
+    batch = shard_batch(self._batch(seed=4), mesh)
+    old = np.asarray(jax.tree_util.tree_leaves(params)[0])  # before donation
+    params2, _, metrics = step(params, opt_state, jax.random.key(1), batch)
+    assert np.isfinite(float(metrics['loss']))
+    assert not np.array_equal(old,
+                              np.asarray(jax.tree_util.tree_leaves(params2)[0]))
+
+  def test_flops_accounting_shrinks(self):
+    from lddl_tpu.models.flops import bert_pretrain_flops_per_step
+    full = bert_pretrain_flops_per_step(TINY, 8, 128)
+    gathered = bert_pretrain_flops_per_step(TINY, 8, 128, max_predictions=20)
+    assert gathered < full
+    d, v = TINY.hidden_size, TINY.vocab_size
+    assert full - gathered == 3 * (2 * 8 * (128 - 20) * d * (d + v))
