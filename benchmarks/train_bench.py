@@ -169,6 +169,10 @@ def build_train_state(args, tokenizer):
                    seq=args.sp)
   print(f'mesh: {mesh_summary(mesh)}; devices={len(jax.devices())} '
         f'({jax.devices()[0].device_kind})')
+  if args.max_predictions is not None:
+    from lddl_tpu.parallel.train import check_max_predictions
+    check_max_predictions(args.max_predictions, args.max_seq_length,
+                          args.masking)
   tx = optax.adamw(1e-4)
   params = init_params(model, mesh, jax.random.key(args.seed),
                        seq_len=min(128, args.max_seq_length))

@@ -158,12 +158,22 @@ class FileBackend(CommBackend):
     deadline = time.monotonic() + self._timeout
     for r in range(self._world_size):
       p = self._path(seq, r)
+      # Exponential backoff from the base poll up to 50 ms: N waiting
+      # ranks each stat-polling every 5 ms measurably steals CPU from the
+      # ranks still working when cores are scarce (an 8-process run on
+      # one core spent most of its wall-clock here); long waits back off,
+      # short waits stay snappy.
+      delay = self._poll
       while not os.path.exists(p):
         if time.monotonic() > deadline:
           raise TimeoutError(
               f'rank {self._rank}: timed out waiting for rank {r} at '
               f'collective #{seq} (dir={self._dir})')
-        time.sleep(self._poll)
+        time.sleep(delay)
+        # Never poll faster than the configured interval: backoff only
+        # coarsens waits, it must not override a deliberately slow poll
+        # (e.g. a rendezvous dir on NFS).
+        delay = min(delay * 2, max(self._poll, 0.05))
       with open(p, 'rb') as f:
         results.append(pickle.loads(f.read()))
     return results

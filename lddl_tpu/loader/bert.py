@@ -227,6 +227,11 @@ class BertPretrainLoader:
     self._batches_consumed = batches_consumed
     self._micro = micro_batch_size
 
+  @property
+  def batch_size(self):
+    """Per-rank samples per yielded batch."""
+    return self._batch
+
   def __len__(self):
     """Batches the *next* ``__iter__`` will yield (short on a resumed
     mid-epoch, full afterwards) — keeps len-driven LR schedules and

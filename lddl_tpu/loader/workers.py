@@ -91,9 +91,8 @@ class MultiprocessLoader:
     return self._serial.samples_per_epoch
 
   @property
-  def _batch(self):
-    # Per-rank batch size; TrainLoop reads this off the serial loader.
-    return self._serial._batch
+  def batch_size(self):
+    return self._serial.batch_size
 
   @property
   def epoch(self):

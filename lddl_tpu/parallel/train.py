@@ -126,6 +126,29 @@ def _train_step_body(model, tx, params, opt_state, rng, batch,
   return params, opt_state, metrics
 
 
+def check_max_predictions(max_predictions, seq_len, masking,
+                          mlm_probability=0.15):
+  """Warn when a masked-only head budget under-covers the masking mode.
+
+  Static masking caps per-row predictions at ``round(s·ratio)(+1)``;
+  dynamic masking is per-position Bernoulli, so its count has a binomial
+  tail — require ~4 standard deviations of headroom before calling the
+  budget safe. An under-sized P silently drops the overflow targets from
+  loss and gradients, which is exactly the quiet failure this warning
+  exists to surface.
+  """
+  budget = round(seq_len * mlm_probability) + 1
+  if masking == 'dynamic':
+    sd = (seq_len * mlm_probability * (1 - mlm_probability)) ** 0.5
+    budget = int(seq_len * mlm_probability + 4 * sd) + 1
+  if max_predictions < min(budget, seq_len):
+    import warnings
+    warnings.warn(
+        f'max_predictions={max_predictions} is below the {masking}-masking '
+        f'budget ~{budget} for seq_len {seq_len}: rows with more masked '
+        'positions silently drop their overflow MLM targets from the loss')
+
+
 def make_train_step(model, tx, mesh, max_predictions=None):
   """Returns ``step(params, opt_state, rng, batch) ->
   (params, opt_state, metrics)``, jitted with donated state.

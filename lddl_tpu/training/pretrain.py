@@ -106,6 +106,9 @@ class TrainLoop:
     params = init_params(model, mesh, jax.random.key(seed),
                          seq_len=min(128, max_seq_length))
     opt_state = _place_opt_state(jax.jit(tx.init)(params), params, mesh)
+    if max_predictions is not None:
+      from ..parallel.train import check_max_predictions
+      check_max_predictions(max_predictions, max_seq_length, masking)
     step_fn = make_train_step(model, tx, mesh,
                               max_predictions=max_predictions)
     global_batch = batch_size_per_rank * dp_world
@@ -204,8 +207,7 @@ class TrainLoop:
 
     from ..loader.device import prefetch_to_device
 
-    global_batch = (self.loader._batch *  # noqa: SLF001 (own class)
-                    max(jax.process_count(), 1))
+    global_batch = self.loader.batch_size * max(jax.process_count(), 1)
     losses = []
     while self.step < max_steps:
       stream = prefetch_to_device(iter(self.loader), mesh=self.mesh,

@@ -213,3 +213,15 @@ class TestMaskedOnlyHead:
     assert gathered < full
     d, v = TINY.hidden_size, TINY.vocab_size
     assert full - gathered == 3 * (2 * 8 * (128 - 20) * d * (d + v))
+
+  def test_under_budget_warns(self):
+    import warnings as w
+
+    from lddl_tpu.parallel.train import check_max_predictions
+    with w.catch_warnings(record=True) as rec:
+      w.simplefilter('always')
+      check_max_predictions(20, 128, 'static')   # budget 20: fine
+      check_max_predictions(32, 128, 'dynamic')  # 19.2 + 4sd ~ 36: warns
+      check_max_predictions(20, 512, 'dynamic')  # way under: warns
+    msgs = [str(r.message) for r in rec]
+    assert len(msgs) == 2 and all('silently drop' in m for m in msgs)
