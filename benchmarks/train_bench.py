@@ -172,7 +172,8 @@ def build_train_state(args, tokenizer):
   if args.max_predictions is not None:
     from lddl_tpu.parallel.train import check_max_predictions
     check_max_predictions(args.max_predictions, args.max_seq_length,
-                          args.masking)
+                          args.masking,
+                          mlm_probability=args.mlm_probability)
   tx = optax.adamw(1e-4)
   params = init_params(model, mesh, jax.random.key(args.seed),
                        seq_len=min(128, args.max_seq_length))
@@ -236,11 +237,17 @@ def run_scan(args, loader, tokenizer):
   flops_per_step = bert_pretrain_flops_per_step(
       cfg, b, s, max_predictions=args.max_predictions)
   times = []
-  for _ in range(args.scan_windows):
-    t0 = time.perf_counter()
-    params, opt_state, metrics = scan(params, opt_state, rng, window)
-    loss = float(metrics['loss'])
-    times.append(time.perf_counter() - t0)
+  if args.profile_dir:
+    jax.profiler.start_trace(args.profile_dir)
+  try:
+    for _ in range(args.scan_windows):
+      t0 = time.perf_counter()
+      params, opt_state, metrics = scan(params, opt_state, rng, window)
+      loss = float(metrics['loss'])
+      times.append(time.perf_counter() - t0)
+  finally:
+    if args.profile_dir:
+      jax.profiler.stop_trace()
   # Median window: robust against tunnel-jitter outliers in either
   # direction (slow links stall; a too-fast sample means a sync anomaly).
   med_step = sorted(times)[len(times) // 2] / k
@@ -478,6 +485,11 @@ def attach_args(parser):
   parser.add_argument('--log-level', default='WARNING',
                       choices=['CRITICAL', 'ERROR', 'WARNING', 'INFO',
                                'DEBUG'])
+  parser.add_argument('--profile-dir', default=None,
+                      help='write a jax.profiler trace of the measured '
+                           'scan windows here (view with TensorBoard or '
+                           'xprof) — device-time ground truth for the '
+                           'MFU numbers')
   parser.add_argument('--seq-len-dir', default=None,
                       help='dump per-rank lens_<rank>.npz here')
   parser.add_argument('--debug', action='store_true',

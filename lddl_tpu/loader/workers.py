@@ -39,10 +39,19 @@ def _mp_context():
   return _mp.get_context()
 
 
-def _worker_main(build_kwargs, epoch, clear_consumed, w, num_workers, q):
+DEFAULT_FACTORY = ('lddl_tpu.loader.bert', 'get_bert_pretrain_data_loader')
+
+
+def _resolve_factory(factory):
+  import importlib
+  module, attr = factory
+  return getattr(importlib.import_module(module), attr)
+
+
+def _worker_main(build_kwargs, factory, epoch, clear_consumed, w,
+                 num_workers, q):
   try:
-    from .bert import get_bert_pretrain_data_loader
-    loader = get_bert_pretrain_data_loader(**build_kwargs)
+    loader = _resolve_factory(factory)(**build_kwargs)
     loader.epoch = epoch
     if clear_consumed:
       loader._batches_consumed = 0
@@ -64,14 +73,14 @@ class MultiprocessLoader:
   (``__len__``, ``samples_per_epoch``) and tracks epoch/resume state.
   """
 
-  def __init__(self, build_kwargs, num_workers):
+  def __init__(self, build_kwargs, num_workers, factory=DEFAULT_FACTORY):
     from ..comm import NullBackend
-    from .bert import get_bert_pretrain_data_loader
     if build_kwargs.get('tokenizer') is not None:
       raise ValueError(
           'num_workers > 0 requires vocab_file/tokenizer_name (worker '
           'processes must reconstruct the tokenizer; a live tokenizer '
           'object does not pickle)')
+    self._factory = tuple(factory)
     self._kwargs = dict(build_kwargs)
     # Workers must NOT participate in comm collectives: they would rejoin
     # the world as duplicate ranks and corrupt the real ranks' collective
@@ -81,7 +90,7 @@ class MultiprocessLoader:
     # metadata needs no collective, and a cache miss just counts locally.
     self._kwargs['comm'] = NullBackend()
     self._num_workers = num_workers
-    self._serial = get_bert_pretrain_data_loader(**build_kwargs)
+    self._serial = _resolve_factory(self._factory)(**build_kwargs)
 
   def __len__(self):
     return len(self._serial)
@@ -128,8 +137,8 @@ class MultiprocessLoader:
     procs = [
         ctx.Process(
             target=_worker_main,
-            args=(self._kwargs, epoch, clear_consumed, w, self._num_workers,
-                  queues[w]),
+            args=(self._kwargs, self._factory, epoch, clear_consumed, w,
+                  self._num_workers, queues[w]),
             daemon=True) for w in range(self._num_workers)
     ]
     for p in procs:

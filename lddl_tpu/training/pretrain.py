@@ -108,7 +108,9 @@ class TrainLoop:
     opt_state = _place_opt_state(jax.jit(tx.init)(params), params, mesh)
     if max_predictions is not None:
       from ..parallel.train import check_max_predictions
-      check_max_predictions(max_predictions, max_seq_length, masking)
+      check_max_predictions(
+          max_predictions, max_seq_length, masking,
+          mlm_probability=(loader_kwargs or {}).get('mlm_probability', 0.15))
     step_fn = make_train_step(model, tx, mesh,
                               max_predictions=max_predictions)
     global_batch = batch_size_per_rank * dp_world

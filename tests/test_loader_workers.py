@@ -93,3 +93,38 @@ def test_abandoned_resume_epoch_resets_len(binned_shards, tiny_vocab):  # noqa: 
       full = len(loader)
     assert len(loader) == full
   _assert_same(_collect(serial), _collect(parallel))
+
+
+def test_codebert_workers_match_serial(tmp_path, tiny_vocab):
+  # The generalized factory path: CodeBERT loader with workers.
+  import pyarrow as pa
+  import pyarrow.parquet as pq
+
+  from lddl_tpu.loader.codebert import get_codebert_pretrain_data_loader
+  d = tmp_path / 'shards'
+  d.mkdir()
+  r = __import__('random').Random(11)
+  for f in range(2):
+    rows = [_mk_code_row(r) for _ in range(8)]
+    cols = {
+        'doc': pa.array([x[0] for x in rows]),
+        'code': pa.array([x[1] for x in rows]),
+        'num_tokens': pa.array([x[2] for x in rows], type=pa.uint16()),
+    }
+    pq.write_table(pa.table(cols), str(d / f'shard-{f}.parquet'))
+  kw = dict(
+      batch_size_per_rank=4, vocab_file=tiny_vocab, max_seq_length=64,
+      base_seed=9)
+  serial = get_codebert_pretrain_data_loader(str(d), **kw)
+  parallel = get_codebert_pretrain_data_loader(str(d), num_workers=2, **kw)
+  got = _collect(serial)
+  assert got[0], 'fixture must yield batches (vacuous pass otherwise)'
+  _assert_same(got, _collect(parallel))
+
+
+def _mk_code_row(r):
+  from conftest import WORDS
+  doc = ' '.join(r.choice(WORDS) for _ in range(r.randrange(3, 8)))
+  code = ' '.join(r.choice(WORDS) for _ in range(r.randrange(6, 20)))
+  nt = len(doc.split()) + len(code.split()) + 3
+  return doc, code, nt
