@@ -126,9 +126,17 @@ def get_bart_pretrain_data_loader(
     tokenizer=None,
     log_dir=None,
     log_level=None,
+    num_workers=0,
 ):
   """Loader over (unbinned) BART `sentences` shards; mirrors
-  :func:`lddl_tpu.loader.get_bert_pretrain_data_loader`."""
+  :func:`lddl_tpu.loader.get_bert_pretrain_data_loader` (including
+  ``num_workers`` worker-process collate with byte-identical output)."""
+  if num_workers:
+    build_kwargs = {k: v for k, v in locals().items() if k != 'num_workers'}
+    from .workers import MultiprocessLoader
+    return MultiprocessLoader(
+        build_kwargs, num_workers,
+        factory=('lddl_tpu.loader.bart', 'get_bart_pretrain_data_loader'))
   if tokenizer is None:
     from ..tokenization.wordpiece import load_bert_tokenizer
     tokenizer = load_bert_tokenizer(

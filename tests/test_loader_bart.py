@@ -100,3 +100,15 @@ def test_raw_samples_mode(tmp_path, tiny_vocab):
   assert len(batches) == 4
   assert isinstance(batches[0], list) and isinstance(batches[0][0], dict)
   assert set(batches[0][0]) >= {'A', 'B', 'is_random_next'}
+
+
+def test_workers_match_serial(bart_shards, tiny_vocab):
+  import numpy as np
+  serial = list(_mk(bart_shards, tiny_vocab))
+  assert serial
+  parallel = list(_mk(bart_shards, tiny_vocab, num_workers=2))
+  assert len(serial) == len(parallel)
+  for a, b in zip(serial, parallel):
+    assert a.keys() == b.keys()
+    for k in a:
+      np.testing.assert_array_equal(a[k], b[k], err_msg=k)
