@@ -14,6 +14,7 @@
 // unchanged (divergence documented in lddl_tpu/native/wordpiece.py).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <functional>
@@ -185,6 +186,8 @@ struct SvHash {
   }
 };
 
+std::atomic<uint64_t> g_model_gen{0};
+
 struct Model {
   std::string vocab_blob;                 // concatenated token bytes
   std::vector<std::string_view> tokens;   // id -> token view into blob
@@ -193,6 +196,93 @@ struct Model {
   int32_t unk_id = 0;
   bool lowercase = true;
   int32_t max_input_chars = 100;
+  // Longest vocab entry in bytes, split by table: substrings longer than
+  // this cannot match, so the longest-match scan starts below it.
+  int32_t max_root_bytes = 0;
+  int32_t max_suffix_bytes = 0;
+  // Unique instance tag (never reused, unlike the heap address) so
+  // thread-local word caches can detect a model switch.
+  uint64_t gen = ++g_model_gen;
+};
+
+// Per-thread memo of normalized-word bytes -> wordpiece ids. Natural text
+// is Zipfian, so a small open-addressing table absorbs almost every word
+// after the first few MB; a hit costs one hash + one memcmp instead of the
+// longest-match probe loop. Purely an evaluation cache: values are the
+// deterministic encode_word output, so cached and uncached paths are
+// byte-identical.
+struct WordCache {
+  static constexpr uint32_t kSlots = 1u << 16;
+  static constexpr uint32_t kMask = kSlots - 1;
+  static constexpr size_t kMaxEntries = 48000;   // ~0.73 load factor cap
+  static constexpr size_t kMaxKeyBytes = 64;     // don't cache pathological words
+  // Don't pay the slot-table memset until the call has seen enough words
+  // to plausibly amortize it (single-text tokenize calls never do).
+  static constexpr uint64_t kActivateAfterWords = 64;
+  struct Slot {
+    int32_t key_off = -1;
+    int32_t key_len = 0;
+    int32_t ids_off = 0;
+    int32_t ids_len = 0;
+  };
+  std::vector<Slot> slots;   // empty until activated
+  std::string keys;
+  std::vector<int32_t> ids;
+  size_t entries = 0;
+  uint64_t model_gen = 0;    // which Model the cached ids belong to
+  uint64_t words_seen = 0;
+
+  bool active() const { return !slots.empty(); }
+
+  // Bind to a model; a switch (or first use) drops all cached entries.
+  void attach(const Model& m) {
+    if (model_gen != m.gen) {
+      slots.clear();
+      keys.clear();
+      ids.clear();
+      entries = 0;
+      words_seen = 0;
+      model_gen = m.gen;
+    }
+  }
+
+  void note_word() {
+    if (!active() && ++words_seen == kActivateAfterWords) {
+      slots.assign(kSlots, Slot{});
+      keys.reserve(1 << 18);
+      ids.reserve(1 << 16);
+    }
+  }
+
+  // Linear-probe to the slot holding `w` (found=true) or the first empty
+  // slot (found=false). The entry cap keeps at least one slot empty, so the
+  // probe always terminates.
+  uint32_t probe(std::string_view w, bool& found) const {
+    uint32_t idx = static_cast<uint32_t>(SvHash{}(w)) & kMask;
+    while (true) {
+      const Slot& s = slots[idx];
+      if (s.key_off < 0) { found = false; return idx; }
+      if (static_cast<size_t>(s.key_len) == w.size() &&
+          std::memcmp(keys.data() + s.key_off, w.data(), w.size()) == 0) {
+        found = true;
+        return idx;
+      }
+      idx = (idx + 1) & kMask;
+    }
+  }
+
+  void insert(uint32_t idx, std::string_view w, const int32_t* v, size_t n) {
+    if (entries >= kMaxEntries || w.size() > kMaxKeyBytes) return;
+    // (idx came from probe() on the active table, so slots is non-empty.)
+    Slot& s = slots[idx];
+    s.key_off = static_cast<int32_t>(keys.size());
+    s.key_len = static_cast<int32_t>(w.size());
+    keys.append(w.data(), w.size());
+    s.ids_off = static_cast<int32_t>(ids.size());
+    s.ids_len = static_cast<int32_t>(n);
+    ids.insert(ids.end(), v, v + n);
+    ++entries;
+  }
 };
 
 // ------------------------------------------------------- word -> wordpiece
@@ -202,6 +292,15 @@ struct Word {
   std::string bytes;
   std::vector<int32_t> cp_off;  // size = n_cp + 1
 };
+
+// One cache per OS thread, rebound (and flushed) on model switch. The
+// calling thread keeps its cache warm across encode calls; short-lived
+// worker threads get a fresh one, whose cost lazy activation bounds.
+WordCache& local_word_cache(const Model& m) {
+  static thread_local WordCache cache;
+  cache.attach(m);
+  return cache;
+}
 
 // Greedy longest-match (HF WordPiece::tokenize semantics): whole word
 // becomes UNK if any position fails to match.
@@ -219,6 +318,11 @@ inline void encode_word(const Model& m, const Word& w,
     int32_t end = n_cp;
     int32_t found = -1;
     const auto& map = (start == 0) ? m.roots : m.suffixes;
+    // Substrings longer than the longest vocab entry can't match; skip
+    // straight down to the first probe-able length.
+    const int32_t max_bytes = (start == 0) ? m.max_root_bytes
+                                           : m.max_suffix_bytes;
+    while (end > start && w.cp_off[end] - w.cp_off[start] > max_bytes) --end;
     while (end > start) {
       std::string_view sub(w.bytes.data() + w.cp_off[start],
                            w.cp_off[end] - w.cp_off[start]);
@@ -238,7 +342,8 @@ inline void encode_word(const Model& m, const Word& w,
 
 // Normalize + pre-tokenize + wordpiece one text into `out`.
 inline void encode_text(const Model& m, const char* s, int64_t len,
-                        std::vector<int32_t>& out, int32_t max_tokens) {
+                        std::vector<int32_t>& out, int32_t max_tokens,
+                        WordCache& cache) {
   Word w;
   w.bytes.reserve(32);
   w.cp_off.reserve(33);
@@ -246,7 +351,23 @@ inline void encode_text(const Model& m, const char* s, int64_t len,
   int64_t i = 0;
   auto flush_word = [&]() {
     if (!w.bytes.empty()) {
-      encode_word(m, w, out);
+      if (cache.active()) {
+        std::string_view key(w.bytes);
+        bool found;
+        uint32_t idx = cache.probe(key, found);
+        if (found) {
+          const WordCache::Slot& sl = cache.slots[idx];
+          out.insert(out.end(), cache.ids.data() + sl.ids_off,
+                     cache.ids.data() + sl.ids_off + sl.ids_len);
+        } else {
+          size_t before = out.size();
+          encode_word(m, w, out);
+          cache.insert(idx, key, out.data() + before, out.size() - before);
+        }
+      } else {
+        encode_word(m, w, out);
+        cache.note_word();
+      }
       w.bytes.clear();
       w.cp_off.clear();
     }
@@ -443,8 +564,12 @@ void* lddl_wp_create(const char* vocab_blob, const int64_t* offsets,
     m->tokens[i] = tok;
     if (tok.size() > 2 && tok[0] == '#' && tok[1] == '#') {
       m->suffixes.emplace(tok.substr(2), i);
+      m->max_suffix_bytes = std::max<int32_t>(
+          m->max_suffix_bytes, static_cast<int32_t>(tok.size()) - 2);
     } else {
       m->roots.emplace(tok, i);
+      m->max_root_bytes = std::max<int32_t>(
+          m->max_root_bytes, static_cast<int32_t>(tok.size()));
     }
   }
   m->unk_id = unk_id;
@@ -474,9 +599,10 @@ int64_t lddl_wp_encode_batch(void* model, const char* blob,
     ThreadSlice& sl = slices[t];
     ranges[t] = {lo, hi};
     sl.ids.reserve((offsets[hi] - offsets[lo]) / 4 + 16);
+    WordCache& cache = local_word_cache(m);
     for (int64_t k = lo; k < hi; ++k) {
       encode_text(m, blob + offsets[k], offsets[k + 1] - offsets[k], sl.ids,
-                  max_tokens);
+                  max_tokens, cache);
       sl.seq_ends.push_back(static_cast<int64_t>(sl.ids.size()));
     }
   };
@@ -533,6 +659,7 @@ int64_t lddl_encode_docs(void* model, const char* blob,
   auto body = [&](int64_t lo, int64_t hi, int t) {
     DocSlice& sl = slices[t];
     std::vector<int64_t> bounds;
+    WordCache& cache = local_word_cache(m);
     for (int64_t d = lo; d < hi; ++d) {
       const char* text = blob + offsets[d];
       int64_t len = offsets[d + 1] - offsets[d];
@@ -542,7 +669,7 @@ int64_t lddl_encode_docs(void* model, const char* blob,
       for (size_t b = 0; b + 1 < bounds.size(); b += 2) {
         size_t before = sl.ids.size();
         encode_text(m, text + bounds[b], bounds[b + 1] - bounds[b], sl.ids,
-                    max_tokens_per_sent);
+                    max_tokens_per_sent, cache);
         if (sl.ids.size() > before) {
           sl.sent_ends.push_back(static_cast<int64_t>(sl.ids.size()));
           ++kept;

@@ -117,7 +117,17 @@ def _special_and_valid(ids_shape_l, row_len, na):
 
 def mask_batch_host(ids_mat, row_len, na, *, masked_lm_ratio, vocab_size,
                     mask_id, np_rng, max_predictions=None):
-  """Vectorized numpy masking. Returns (masked_mat, picked_mask)."""
+  """Vectorized numpy masking. Returns (masked_mat, picked_mask).
+
+  Determinism contract: bit-identical for a given (seed, inputs) within a
+  framework version. The draw layout is NOT stable across versions (the
+  decide/replacement draws are taken sparsely at picked positions), so a
+  shard regenerated with the same seed under a different version may carry
+  different mask bits — pair structure and all non-mask columns are
+  unaffected. Matches the repo-wide masking contract
+  (tests/test_fast_pipeline.py: "masking bits differ across backends;
+  pair structure must not").
+  """
   n, l = ids_mat.shape
   if n == 0:
     return ids_mat.copy(), np.zeros((0, l), dtype=bool)
@@ -128,25 +138,37 @@ def mask_batch_host(ids_mat, row_len, na, *, masked_lm_ratio, vocab_size,
   if max_predictions is not None:
     k = np.minimum(k, max_predictions)
   k = np.minimum(k, valid.sum(axis=1))
-  # rank of each u within its row; the k smallest valid entries win.
-  # Sort tie-free uint64 keys (positive-float bit patterns order like the
-  # floats; the lane index replaces the low mantissa bits) so the fast
-  # default introsort is deterministic across numpy versions — equal
-  # float64 draws would otherwise tie-break by sort implementation.
+  # The k smallest valid draws per row win. Sort tie-free uint64 keys
+  # (positive-float bit patterns order like the floats; the lane index
+  # replaces the low mantissa bits) so the result is deterministic across
+  # numpy versions — equal float64 draws would otherwise tie-break by sort
+  # implementation. argpartition moves the kmax smallest to the front in
+  # O(l); only that prefix needs the real sort.
   lane_bits = max(1, (l - 1)).bit_length()
   keys = (u.view(np.uint64) & ~np.uint64((1 << lane_bits) - 1)
           | np.arange(l, dtype=np.uint64)[None, :])
-  order = np.argsort(keys, axis=1)
-  ranks = np.empty_like(order)
-  rows = np.arange(n)[:, None]
-  ranks[rows, order] = np.arange(l)[None, :]
-  picked = (ranks < k[:, None]) & valid
-  decide = np_rng.random((n, l))
-  rand_ids = np_rng.integers(0, vocab_size, (n, l), dtype=np.int32)
+  kmax = int(k.max())
+  picked = np.zeros((n, l), dtype=bool)
+  if kmax < l:
+    part = np.argpartition(keys, kmax, axis=1)[:, :kmax]
+    vals = np.take_along_axis(keys, part, axis=1)
+    sel = np.take_along_axis(part, np.argsort(vals, axis=1), axis=1)
+  else:
+    sel = np.argsort(keys, axis=1)
+  in_k = np.arange(sel.shape[1], dtype=np.int64)[None, :] < k[:, None]
+  rr, cc = np.nonzero(in_k)
+  picked[rr, sel[rr, cc]] = True
+  picked &= valid
+  # decide / replacement draws only at picked positions (~ratio of the
+  # matrix) instead of dense (n, l) matrices.
+  pr, pc = np.nonzero(picked)
+  decide = np_rng.random(len(pr))
+  rand_ids = np_rng.integers(0, vocab_size, len(pr), dtype=np.int32)
   masked = ids_mat.copy()
-  masked[picked & (decide < 0.8)] = mask_id
-  keep_random = picked & (decide >= 0.9)
-  masked[keep_random] = rand_ids[keep_random]
+  to_mask = decide < 0.8
+  masked[pr[to_mask], pc[to_mask]] = mask_id
+  keep_random = decide >= 0.9
+  masked[pr[keep_random], pc[keep_random]] = rand_ids[keep_random]
   return masked, picked
 
 

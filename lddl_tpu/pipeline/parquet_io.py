@@ -93,7 +93,12 @@ def write_table_partition(
 
   def _write(tbl, path):
     if output_format == 'parquet':
-      pq.write_table(tbl, path, compression=compression)
+      # Dictionary encoding buys nothing on long, mostly-unique token
+      # strings, and per-page statistics are never consulted by the
+      # loader (row counts come from the footer) — both are pure
+      # writer-side cost here.
+      pq.write_table(tbl, path, compression=compression,
+                     use_dictionary=False, write_statistics=False)
     elif output_format == 'txt':
       with open(path, 'w', encoding='utf-8') as f:
         for row in tbl.to_pylist():

@@ -126,11 +126,38 @@ def read_lines(text_slice, encoding='utf-8'):
         pos += len(chunk)
     else:
       f.seek(0)
-    while pos < text_slice.end:
-      line = f.readline()
-      if not line:
+    if pos >= text_slice.end:
+      return
+    # Chunked bulk reads with a carried remainder: the syscall win of
+    # block reads at O(chunk) memory, not O(slice) (slices can be hundreds
+    # of MB when few workers partition a large corpus).
+    chunk_size = 8 << 20
+    remaining = text_slice.end - pos
+    # Newline-free chunks accumulate in a list (joined only once a newline
+    # arrives), so a pathological single-line slice costs O(line) total
+    # copying, not O(line * chunks).
+    pending = []
+    while remaining > 0:
+      chunk = f.read(min(chunk_size, remaining))
+      if not chunk:
         break
-      pos += len(line)
-      text = line.decode(encoding).rstrip('\r\n')
-      if text.strip():
-        yield text
+      remaining -= len(chunk)
+      pending.append(chunk)
+      if chunk.rfind(b'\n') < 0:
+        continue
+      data = b''.join(pending)
+      nl = data.rfind(b'\n')
+      pending = [data[nl + 1:]] if nl + 1 < len(data) else []
+      for line in data[:nl].split(b'\n'):
+        text = line.decode(encoding).rstrip('\r')
+        if text.strip():
+          yield text
+    rem = b''.join(pending)
+    if rem:
+      # The final line straddles the slice end (or the file ends without a
+      # newline): finish it, matching the ownership rule.
+      rem += f.readline()
+      for line in rem.split(b'\n'):
+        text = line.decode(encoding).rstrip('\r')
+        if text.strip():
+          yield text

@@ -56,8 +56,8 @@ def scatter_partition(lines, src_index, num_targets, spill_dir, seed,
     os.makedirs(tgt_dir, exist_ok=True)
     tmp = os.path.join(tgt_dir, f'.src{src_index}.tmp')
     with open(tmp, 'w', encoding='utf-8', newline='') as f:
-      for line in bucket:
-        f.write(line + delimiter)
+      f.write(delimiter.join(bucket))
+      f.write(delimiter)
     os.rename(tmp, os.path.join(tgt_dir, f'src{src_index}.txt'))
   return counts
 

@@ -177,8 +177,14 @@ def test_pairing_falls_back_without_toolchain(monkeypatch):
   def boom():
     raise FileNotFoundError('g++')
 
+  # native.pairing binds `load_library` at import time; import it first so
+  # the patch below cannot be captured permanently by a first-time import
+  # happening inside this test (which would leak `boom` into later tests).
+  from lddl_tpu.native import pairing as native_pairing
+
   monkeypatch.setattr(pairing, '_NATIVE_PLANNER', None)
   monkeypatch.setattr(build, 'load_library', boom)
+  monkeypatch.setattr(native_pairing, 'load_library', boom)
   docs = pairing.TokenizedDocs(
       np.arange(40, dtype=np.int32),
       np.array([0, 10, 25, 40], dtype=np.int64), [2, 1])
