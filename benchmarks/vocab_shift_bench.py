@@ -1,0 +1,153 @@
+"""Distribution-shift sensitivity check for the headline bench vocab.
+
+The committed 30,522-entry bench vocabulary is trained on the same
+synthetic distribution the headline corpus is drawn from
+(``make_bench_vocab.py``), so longest-match sees mostly whole-word hits.
+ADVICE r3 asked: how much does that flatter throughput? (Note the same
+is true of real-world BERT preprocessing — ``bert-base-uncased``'s vocab
+was itself trained on Wikipedia+Books — so "in-distribution" is the
+realistic regime; this bench bounds the *out*-of-distribution penalty.)
+
+This script measures the native tokenizer and the full preprocess
+pipeline on three corpora with the SAME committed vocab:
+
+  A. in-distribution  — the default word population (what the headline
+     bench and the vocab trainer both use), held-out document seed;
+  B. shifted stems    — ``build_word_population(seed=777)``: a disjoint
+     stem pool, so whole-word vocab hits mostly vanish and longest-match
+     does real multi-probe suffix work (harsher than any natural drift);
+  C. heavy tail       — 100k word types (double the default), thinning
+     every frequency band and the word-cache hit rate.
+
+Writes a small table to stdout and (with ``--out``) to a results file.
+"""
+
+import argparse
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+_VOCAB = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'assets',
+                      'bench_vocab_30522.txt')
+
+
+def _write_shifted_corpus(out_dir, target_mb, population_kwargs, doc_seed,
+                          num_shards=4):
+  """write_corpus with a configurable word population."""
+  from lddl_tpu.core.synth import build_word_population, generate_documents
+  os.makedirs(out_dir, exist_ok=True)
+  words, probs = build_word_population(**population_kwargs)
+  target = int(target_mb * 1024 * 1024)
+  files = [
+      open(os.path.join(out_dir, f'{i}.txt'), 'w', encoding='utf-8')
+      for i in range(num_shards)
+  ]
+  try:
+    written = 0
+    for doc_id, doc in enumerate(
+        generate_documents(words, probs, target, seed=doc_seed)):
+      line = f'shift-{doc_id} {doc}\n'
+      files[doc_id % num_shards].write(line)
+      written += len(line.encode('utf-8'))
+      if written >= target:
+        break
+  finally:
+    for f in files:
+      f.close()
+  return written / (1024 * 1024)
+
+
+def _tokenizer_mbps(src_dir, wp, trials=3):
+  lines = []
+  for name in sorted(os.listdir(src_dir)):
+    with open(os.path.join(src_dir, name), encoding='utf-8') as f:
+      for line in f:
+        parts = line.rstrip('\n').split(' ', 1)
+        lines.append(parts[1] if len(parts) > 1 else parts[0])
+  nbytes = sum(len(l.encode('utf-8')) for l in lines)
+  wp.encode_docs(lines[:50])  # warm
+  best = float('inf')
+  unk = total = 0
+  for _ in range(trials):
+    t0 = time.perf_counter()
+    ids, _, _ = wp.encode_docs(lines)
+    best = min(best, time.perf_counter() - t0)
+  unk_id = wp.vocab_words.index('[UNK]') if '[UNK]' in wp.vocab_words else 0
+  unk = int((ids == unk_id).sum())
+  total = len(ids)
+  return nbytes / 1e6 / best, unk / max(1, total), total / (nbytes / 1e6)
+
+
+def _pipeline_mbps(src_dir, mb):
+  from lddl_tpu.pipeline.executor import Executor
+  from lddl_tpu.preprocess.bert import BertPretrainConfig, run
+  from lddl_tpu.preprocess.readers import read_corpus
+  cfg = BertPretrainConfig(
+      vocab_file=_VOCAB, target_seq_length=128, bin_size=32,
+      duplicate_factor=1, masking=True, sentence_backend='rules', seed=42,
+      engine='fast', tokenizer_backend='native', mask_backend='host')
+  ex = Executor()
+  sink = tempfile.mkdtemp(prefix='shift_sink_')
+  try:
+    corpus = read_corpus([src_dir], num_blocks=4 * ex.num_local_workers)
+    run(corpus, os.path.join(sink, 'warm'), cfg, executor=ex)
+    shutil.rmtree(os.path.join(sink, 'warm'), ignore_errors=True)
+    corpus = read_corpus([src_dir], num_blocks=4 * ex.num_local_workers)
+    t0 = time.perf_counter()
+    run(corpus, os.path.join(sink, 'out'), cfg, executor=ex)
+    return mb / (time.perf_counter() - t0)
+  finally:
+    shutil.rmtree(sink, ignore_errors=True)
+
+
+def main(argv=None):
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument('--mb', type=float, default=16.0)
+  p.add_argument('--out', default=None,
+                 help='also append the table to this file')
+  args = p.parse_args(argv)
+
+  from lddl_tpu.native.wordpiece import NativeWordPiece
+  with open(_VOCAB, encoding='utf-8') as f:
+    vocab = [l.rstrip('\n') for l in f]
+  wp = NativeWordPiece(vocab, num_threads=1)
+
+  cases = [
+      ('A in-distribution', dict(), 4242),
+      ('B shifted stems', dict(seed=777), 4242),
+      ('C heavy tail 100k', dict(n_types=100000), 4242),
+  ]
+  rows = []
+  for name, pop_kwargs, doc_seed in cases:
+    work = tempfile.mkdtemp(prefix='shift_src_')
+    try:
+      mb = _write_shifted_corpus(work, args.mb, pop_kwargs, doc_seed)
+      tok_mbps, unk_frac, tok_per_mb = _tokenizer_mbps(work, wp)
+      pipe_mbps = _pipeline_mbps(work, mb)
+      rows.append((name, tok_mbps, unk_frac, tok_per_mb, pipe_mbps))
+      print(f'{name:22s} tokenizer {tok_mbps:6.1f} MB/s  UNK {unk_frac:6.2%}'
+            f'  tokens/MB {tok_per_mb:9.0f}  pipeline {pipe_mbps:5.1f} MB/s',
+            flush=True)
+    finally:
+      shutil.rmtree(work, ignore_errors=True)
+
+  base = rows[0]
+  lines = ['# vocab distribution-shift sensitivity '
+           f'(corpus {args.mb:.0f} MB, committed 30,522-entry vocab)',
+           '# case | tokenizer MB/s | UNK frac | tokens/MB | pipeline MB/s '
+           '| pipeline vs in-dist']
+  for r in rows:
+    lines.append(f'{r[0]} | {r[1]:.1f} | {r[2]:.4f} | {r[3]:.0f} | '
+                 f'{r[4]:.2f} | {r[4] / base[4]:.2f}x')
+  text = '\n'.join(lines) + '\n'
+  print(text)
+  if args.out:
+    with open(args.out, 'w', encoding='utf-8') as f:
+      f.write(text)
+
+
+if __name__ == '__main__':
+  main()
