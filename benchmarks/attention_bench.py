@@ -1,0 +1,157 @@
+"""Flash vs dense attention timings and the long-context memory crossover.
+
+Backs PERF.md's flash-attention section with a committed artifact: for a
+BERT-base-shaped head layout ([batch, 12 heads, s, 64], bf16) this times
+the Pallas flash kernel (`lddl_tpu/ops/flash_attention.py`) against the
+dense einsum path — forward and forward+backward — across sequence
+lengths, and records where the dense path stops fitting on the chip
+while flash keeps going (no O(s^2) score materialization in either
+pass). Run on the attached TPU; results land in
+``benchmarks/results/attention_v5e.txt`` with ``--out``.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _dense_attention(q, k, v):
+  import jax.numpy as jnp
+  d = q.shape[-1]
+  scores = jnp.einsum('bhqd,bhkd->bhqk', q, k) / np.sqrt(d).astype(q.dtype)
+  probs = jnp.asarray(
+      jnp.exp(scores - scores.max(axis=-1, keepdims=True)), q.dtype)
+  probs = probs / probs.sum(axis=-1, keepdims=True)
+  return jnp.einsum('bhqk,bhkd->bhqd', probs, v)
+
+
+def _sync(out):
+  # Synchronize via a device->host scalar fetch: on the tunneled-chip
+  # platform block_until_ready has been observed to return before
+  # execution finishes (same workaround as train_bench.run_scan).
+  import jax
+  leaf = jax.tree_util.tree_leaves(out)[0]
+  np.asarray(leaf.ravel()[0])
+
+
+def _make_scanned_fwd(fn, n):
+  """Chain n applications (each output feeds the next query) inside one
+  jit program, so the tunneled link's ~100 ms per-dispatch floor
+  amortizes n-fold — the same methodology as train_bench --scan-steps.
+  The data dependency between iterations prevents XLA from removing or
+  parallelizing the repeats."""
+  import jax
+  from jax import lax
+
+  @jax.jit
+  def run(q, k, v):
+    def body(c, _):
+      return fn(c, k, v), ()
+    out, _ = lax.scan(body, q, None, length=n)
+    return out
+  return run
+
+
+def _make_scanned_bwd(fn, n):
+  import jax
+  import jax.numpy as jnp
+  from jax import lax
+
+  def loss(q, k, v):
+    return jnp.sum(fn(q, k, v).astype(jnp.float32))
+  g = jax.grad(loss, argnums=(0, 1, 2))
+
+  @jax.jit
+  def run(q, k, v):
+    def body(c, _):
+      dq, dk, dv = g(c, k, v)
+      # Chain through all three grads (same shape here since s_q == s_kv)
+      # so XLA cannot dead-code-eliminate any part of the backward pass,
+      # and the data dependency serializes iterations.
+      return c + (dq + dk + dv).astype(c.dtype) * 1e-6, ()
+    out, _ = lax.scan(body, q, None, length=n)
+    return out
+  return run
+
+
+def _time_per_step(run, n, q, k, v, trials=5):
+  _sync(run(q, k, v))  # compile + warm
+  times = []
+  for _ in range(trials):
+    t0 = time.perf_counter()
+    _sync(run(q, k, v))
+    times.append(time.perf_counter() - t0)
+  return float(np.median(times) * 1000 / n)
+
+
+def main(argv=None):
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument('--batch', type=int, default=8)
+  p.add_argument('--heads', type=int, default=12)
+  p.add_argument('--head-dim', type=int, default=64)
+  p.add_argument('--seqs', default='512,1024,2048,4096,8192,16384')
+  p.add_argument('--trials', type=int, default=5)
+  p.add_argument('--out', default=None)
+  args = p.parse_args(argv)
+
+  import jax
+  import jax.numpy as jnp
+
+  from lddl_tpu.ops.flash_attention import flash_attention
+
+  dev = jax.devices()[0]
+  header = (f'# attention bench on {dev.device_kind}: batch={args.batch} '
+            f'heads={args.heads} head_dim={args.head_dim} bf16, median of '
+            f'{args.trials} scan windows, per-step = window/n (dispatch '
+            'amortized inside one jit program)\n'
+            '# s | n | dense fwd ms | flash fwd ms | dense fwd+bwd ms | '
+            'flash fwd+bwd ms')
+  lines = [header]
+  print(header, flush=True)
+
+  for s in [int(x) for x in args.seqs.split(',')]:
+    key = jax.random.key(s)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (args.batch, args.heads, s, args.head_dim)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+    # Deeper scans at short s, where per-step work is smallest relative
+    # to the ~100 ms dispatch floor.
+    n = max(8, min(256, (4096 * 32) // s))
+
+    cells = []
+    for make, fn in ((_make_scanned_fwd, _dense_attention),
+                     (_make_scanned_fwd, flash_attention),
+                     (_make_scanned_bwd, _dense_attention),
+                     (_make_scanned_bwd, flash_attention)):
+      try:
+        run = make(fn, n)
+        cells.append(f'{_time_per_step(run, n, q, k, v, trials=args.trials):8.2f}')
+      except Exception as e:  # noqa: BLE001 — OOM is the datapoint here
+        msg = str(e)
+        if ('RESOURCE_EXHAUSTED' in msg or 'Ran out of memory' in msg
+            or 'hbm capacity' in msg):
+          cells.append('     OOM')
+        else:
+          # A non-OOM failure is a defect, not a datapoint: surface it.
+          print(f'ERR at s={s} ({fn.__name__}): {msg[:500]}',
+                file=sys.stderr, flush=True)
+          cells.append('     ERR')
+    row = f'{s:6d} | {n:3d} | ' + ' | '.join(cells)
+    lines.append(row)
+    print(row, flush=True)
+
+  text = '\n'.join(lines) + '\n'
+  if args.out:
+    with open(args.out, 'w', encoding='utf-8') as f:
+      f.write(text)
+
+
+if __name__ == '__main__':
+  main()

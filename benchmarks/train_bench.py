@@ -201,11 +201,17 @@ def run_scan(args, loader, tokenizer):
   cfg, mesh, model, tx, _, params, opt_state = build_train_state(
       args, tokenizer)
   k = args.scan_steps
-  # K batches of one static shape (whichever bin shape fills first wins).
+  # K batches of one static shape (whichever bin shape fills first wins,
+  # unless --scan-seq-len pins a specific bin's padded length — e.g. 512
+  # for a phase-2 datapoint, which short-pair bins would otherwise
+  # outrace).
   by_shape = {}
   batches = None
   for batch in loader:
     check_batch(batch)
+    if (args.scan_seq_len and
+        batch['input_ids'].shape[1] != args.scan_seq_len):
+      continue
     group = by_shape.setdefault(batch['input_ids'].shape, [])
     group.append(batch)
     if len(group) == k:
@@ -213,8 +219,11 @@ def run_scan(args, loader, tokenizer):
       break
   if batches is None:
     best = max(by_shape.values(), key=len, default=[])
+    hint = ('no batch matched --scan-seq-len '
+            f'{args.scan_seq_len} (check the dataset has that bin); '
+            if args.scan_seq_len and not by_shape else '')
     raise SystemExit(
-        f'no bin yielded {k} batches (best: {len(best)}); lower '
+        f'no bin yielded {k} batches (best: {len(best)}); {hint}lower '
         '--scan-steps or use a bigger dataset')
   shape = batches[0]['input_ids'].shape
   window = stack_batch_window(batches, mesh)
@@ -509,6 +518,10 @@ def attach_args(parser):
                            'one program per step')
   parser.add_argument('--scan-windows', type=int, default=8,
                       help='timed window executions in --scan-steps mode')
+  parser.add_argument('--scan-seq-len', type=int, default=None,
+                      help='collect the scan window from the bin with this '
+                           'padded sequence length instead of the first '
+                           'bin to fill (e.g. 512 for a phase-2 row)')
   parser.add_argument('--peak-tflops', type=float, default=None,
                       help='override per-chip peak bf16 TFLOP/s for MFU')
   parser.add_argument('--attention', default='dense',

@@ -25,10 +25,13 @@ Writes a small table to stdout and (with ``--out``) to a results file.
 import argparse
 import os
 import shutil
+import sys
 import tempfile
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 _VOCAB = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'assets',
                       'bench_vocab_30522.txt')
