@@ -9,10 +9,19 @@ recomputes probabilities blockwise from the saved log-sum-exp instead of
 storing them.
 
 Layout: inputs ``[batch, heads, seq, head_dim]`` are flattened to
-``[batch*heads, seq, head_dim]``; the grid walks (batch*heads, q-blocks)
-for forward/dq and (batch*heads, k-blocks) for dk/dv, with full per-head
-K/V resident in VMEM (fine through multi-k sequences: 2048 x 64 x 4B =
-512 KB/head-operand) and 128-wide blocks feeding the MXU.
+``[batch*heads, seq, head_dim]``; the grid walks (batch*heads,
+q-blocks, k-blocks) for forward/dq and (batch*heads, k-blocks,
+q-blocks) for dk/dv — the contracted sequence axis is the *innermost*
+(sequential) grid dimension, with the running state (max/sum/acc or
+gradient accumulators) in VMEM scratch that persists across those
+steps. VMEM residency per grid step is one 128-row q/output tile plus
+one kv block of up to ``_BLOCK_KV_FWD``/``_BLOCK_KV_BWD`` (4096/2048)
+keys — a few MB total, independent of sequence length (an earlier
+revision held full per-head K/V in VMEM, capping single-chip sequences
+at ~8k; the grid-blocked form runs 32k+). K/V lengths that don't divide
+into whole blocks are padded up to the next block boundary with
+-inf-biased columns (``_kv_blocking``), never dropped to slow 128-wide
+blocks.
 
 Masking: a key-side additive bias ``[batch, seq]`` (0 = attend, -1e9 =
 padding) — the same semantics as the dense path and the ring
@@ -36,6 +45,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e9
 
@@ -45,109 +55,172 @@ def _interpret():
 
 
 def _padded_len(s):
-  """Kernel sequence length: a multiple of the block size so every
-  ``pl.ds`` slice is in bounds (pallas clamps out-of-bounds dynamic
-  slices, which would silently shift tail-block data instead of
-  erroring). The wrapper pads inputs to this length — padded key columns
-  carry a -inf bias, padded query rows are sliced away."""
+  """Kernel sequence length: rounded up so BlockSpec blocks tile the
+  array exactly — a block extending past the array end has undefined
+  out-of-bounds contents, which would corrupt the tail q/kv block. The
+  wrapper pads inputs to this length — padded key columns carry a -inf
+  bias, padded query rows are sliced away."""
   if s <= 128:
     return ((s + 7) // 8) * 8  # sublane-tile multiple
   return ((s + 127) // 128) * 128
 
 
-def _block_sizes(s):
-  return min(128, s), min(128, s)
+# Tuned on v5e: the q block sets the output tile (128 = one MXU tile of
+# rows); the kv block is the unit streamed through the innermost grid
+# dimension — larger blocks amortize per-grid-step overhead (128-wide kv
+# blocks measured 3-4x slower than 2048-wide at s>=2048) while VMEM use
+# stays modest (2 x block_k x 64 x 2B double-buffered ~= 1 MB at 2048).
+_BLOCK_Q = 128
+_BLOCK_KV_FWD = 4096   # fwd: scores + (m,l,acc) scratch fit comfortably
+_BLOCK_KV_BWD = 2048   # bwd: dk/dv f32 scratch doubles VMEM per block
 
 
-def _col_bias(bias_ref, j0, width):
-  return bias_ref[0, 0, pl.ds(j0, width)].astype(jnp.float32)
+def _kv_blocking(s_kv_pad, cap):
+  """(block, padded_kv): a kv block <= cap (multiple of 128, or the whole
+  length when it fits in one block) and the kv length rounded up to a
+  whole number of blocks. Rather than requiring the block to divide the
+  incoming length (which collapses to slow 128-wide blocks whenever the
+  length has no large divisor), the caller pads K/V/bias up to
+  ``padded_kv`` — masked padding columns cost at most one extra
+  fractional block of compute (<= ~6% at s >= 2k)."""
+  if s_kv_pad <= cap:
+    return s_kv_pad, s_kv_pad
+  n_steps = -(-s_kv_pad // cap)
+  block = -(-s_kv_pad // (n_steps * 128)) * 128
+  return block, block * n_steps
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref, *, s_kv,
-                scale, block_k):
+def _pad_kv(k, v, bias, padded_kv):
+  s_kv = k.shape[1]
+  if padded_kv == s_kv:
+    return k, v, bias
+  grow = ((0, 0), (0, padded_kv - s_kv), (0, 0))
+  return (jnp.pad(k, grow), jnp.pad(v, grow),
+          jnp.pad(bias, ((0, 0), (0, 0), (0, padded_kv - s_kv)),
+                  constant_values=NEG_INF))
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, scale):
+  """Grid (bh, q-blocks, kv-blocks); kv is the innermost (sequential)
+  dimension. The running (max, sum, accumulator) lives in VMEM scratch,
+  which persists across grid steps: reset on the first kv block,
+  finalized into (o, lse) on the last."""
+  j = pl.program_id(2)
+
+  @pl.when(j == 0)
+  def _init():
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
   q = q_ref[0].astype(jnp.float32)  # [bq, d]
-  bq, d = q.shape
-  m = jnp.full((bq, 1), NEG_INF, jnp.float32)
-  l = jnp.zeros((bq, 1), jnp.float32)
-  acc = jnp.zeros((bq, d), jnp.float32)
-  for j in range(pl.cdiv(s_kv, block_k)):
-    j0 = j * block_k
-    k_blk = k_ref[0, pl.ds(j0, block_k), :].astype(jnp.float32)
-    v_blk = v_ref[0, pl.ds(j0, block_k), :].astype(jnp.float32)
-    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-    scores = scores + _col_bias(bias_ref, j0, block_k)[None, :]
-    m_blk = jnp.max(scores, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m, m_blk)
-    p = jnp.exp(scores - m_new)
-    alpha = jnp.exp(m - m_new)
-    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    acc = acc * alpha + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
-    m = m_new
-  o_ref[0] = (acc / l).astype(o_ref.dtype)
-  lse_ref[0] = m + jnp.log(l)
+  k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
+  v_blk = v_ref[0].astype(jnp.float32)
+  scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+  scores = scores + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+  m = m_ref[...]
+  m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+  p = jnp.exp(scores - m_new)
+  alpha = jnp.exp(m - m_new)
+  l_new = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+  acc_new = acc_ref[...] * alpha + jnp.dot(p, v_blk,
+                                           preferred_element_type=jnp.float32)
+  m_ref[...] = m_new
+  l_ref[...] = l_new
+  acc_ref[...] = acc_new
+
+  @pl.when(j == pl.num_programs(2) - 1)
+  def _finalize():
+    o_ref[0] = (acc_new / l_new).astype(o_ref.dtype)
+    lse_ref[0] = m_new + jnp.log(l_new)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, *, s_kv, scale, block_k):
+               dq_ref, dq_acc_ref, *, scale):
+  """Grid (bh, q-blocks, kv-blocks), kv innermost; dq accumulates in
+  scratch across the kv sweep."""
+  j = pl.program_id(2)
+
+  @pl.when(j == 0)
+  def _init():
+    dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
   q = q_ref[0].astype(jnp.float32)
   do = do_ref[0].astype(jnp.float32)
   lse = lse_ref[0]      # [bq, 1]
   delta = delta_ref[0]  # [bq, 1]
-  dq = jnp.zeros_like(q)
-  for j in range(pl.cdiv(s_kv, block_k)):
-    j0 = j * block_k
-    k_blk = k_ref[0, pl.ds(j0, block_k), :].astype(jnp.float32)
-    v_blk = v_ref[0, pl.ds(j0, block_k), :].astype(jnp.float32)
-    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-    scores = scores + _col_bias(bias_ref, j0, block_k)[None, :]
-    p = jnp.exp(scores - lse)
-    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)
-    dq = dq + jnp.dot(ds, k_blk, preferred_element_type=jnp.float32)
-  dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+  k_blk = k_ref[0].astype(jnp.float32)
+  v_blk = v_ref[0].astype(jnp.float32)
+  scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+  scores = scores + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+  p = jnp.exp(scores - lse)
+  dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+  ds = p * (dp - delta)
+  dq_acc = dq_acc_ref[...] + jnp.dot(ds, k_blk,
+                                     preferred_element_type=jnp.float32)
+  dq_acc_ref[...] = dq_acc
+
+  @pl.when(j == pl.num_programs(2) - 1)
+  def _finalize():
+    dq_ref[0] = (dq_acc * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, s_q, scale, block_q):
+                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale):
+  """Grid (bh, kv-blocks, q-blocks), q innermost; dk/dv accumulate in
+  scratch across the q sweep while the (k, v) block stays resident."""
+  i = pl.program_id(2)
+
+  @pl.when(i == 0)
+  def _init():
+    dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+    dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
   k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
   v_blk = v_ref[0].astype(jnp.float32)
-  bk, d = k_blk.shape
-  j0 = pl.program_id(1) * bk
-  bias = _col_bias(bias_ref, j0, bk)[None, :]
-  dk = jnp.zeros((bk, d), jnp.float32)
-  dv = jnp.zeros((bk, d), jnp.float32)
-  for i in range(pl.cdiv(s_q, block_q)):
-    i0 = i * block_q
-    q = q_ref[0, pl.ds(i0, block_q), :].astype(jnp.float32)
-    do = do_ref[0, pl.ds(i0, block_q), :].astype(jnp.float32)
-    lse = lse_ref[0, pl.ds(i0, block_q), :]
-    delta = delta_ref[0, pl.ds(i0, block_q), :]
-    # Rows beyond the real sequence carry lse from padded-q garbage; their
-    # dO is zero (cotangents of padding outputs are never produced by the
-    # loss) so they contribute nothing — but guard exp() overflow anyway.
-    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-    scores = scores + bias
-    p = jnp.exp(jnp.minimum(scores - lse, 30.0))
-    dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
-    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta)
-    dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-  dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-  dv_ref[0] = dv.astype(dv_ref.dtype)
+  bias = bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+  q = q_ref[0].astype(jnp.float32)
+  do = do_ref[0].astype(jnp.float32)
+  lse = lse_ref[0]
+  delta = delta_ref[0]
+  # Rows beyond the real sequence carry lse from padded-q garbage; their
+  # dO is zero (cotangents of padding outputs are never produced by the
+  # loss) so they contribute nothing — but guard exp() overflow anyway.
+  scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+  scores = scores + bias
+  p = jnp.exp(jnp.minimum(scores - lse, 30.0))
+  dv_acc = dv_acc_ref[...] + jnp.dot(p.T, do,
+                                     preferred_element_type=jnp.float32)
+  dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+  ds = p * (dp - delta)
+  dk_acc = dk_acc_ref[...] + jnp.dot(ds.T, q,
+                                     preferred_element_type=jnp.float32)
+  dk_acc_ref[...] = dk_acc
+  dv_acc_ref[...] = dv_acc
+
+  @pl.when(i == pl.num_programs(2) - 1)
+  def _finalize():
+    dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
-def _specs(s_q, s_kv, d, heads, block_q):
-  """(blocked q-side spec, full kv-side spec, bias spec) for grid
-  (bh, q-blocks).
+# Layout note for the BlockSpecs below: TPU lowering requires each
+# block's last two dims to be (multiple-of-8, multiple-of-128) or equal
+# to the array dims, so scalar rows ride as trailing-singleton 3-D
+# arrays — bias ``[b, 1, s_kv]``, lse/delta ``[bh, s_q, 1]``.
 
-  Layout note: TPU lowering requires each block's last two dims to be
-  (multiple-of-8, multiple-of-128) or equal to the array dims, so scalar
-  rows ride as trailing-singleton 3-D arrays — bias ``[b, 1, s_kv]``,
-  lse/delta ``[bh, s_q, 1]``."""
-  blocked = pl.BlockSpec((1, block_q, d), lambda i, b: (i, b, 0))
-  full = pl.BlockSpec((1, s_kv, d), lambda i, b: (i, 0, 0))
-  bias = pl.BlockSpec((1, 1, s_kv), lambda i, b: (i // heads, 0, 0))
-  return blocked, full, bias
+
+def _qkv_specs(block_q, block_k, d, heads):
+  """Shared specs for the (bh, q-blocks, kv-blocks) grid used by both
+  the forward and dq pallas_calls — one point of truth so their block
+  shapes and index maps cannot desynchronize. Returns
+  (q_spec, kv_spec, bias_spec, row_spec)."""
+  q_spec = pl.BlockSpec((1, block_q, d), lambda i, b, j: (i, b, 0))
+  kv_spec = pl.BlockSpec((1, block_k, d), lambda i, b, j: (i, j, 0))
+  bias_spec = pl.BlockSpec((1, 1, block_k), lambda i, b, j: (i // heads, 0, j))
+  row_spec = pl.BlockSpec((1, block_q, 1), lambda i, b, j: (i, b, 0))
+  return q_spec, kv_spec, bias_spec, row_spec
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -160,23 +233,27 @@ def _flash_pair(q, k, v, bias, heads):
 
 def _flash_fwd_impl(q, k, v, bias, heads):
   bh, s_q, d = q.shape
-  s_kv = k.shape[1]
-  block_q, _ = _block_sizes(s_q)
-  _, block_k = _block_sizes(s_kv)
-  grid = (bh, pl.cdiv(s_q, block_q))
-  q_spec, full_spec, bias_spec = _specs(s_q, s_kv, d, heads, block_q)
+  block_q = min(_BLOCK_Q, s_q)
+  block_k, padded_kv = _kv_blocking(k.shape[1], _BLOCK_KV_FWD)
+  k, v, bias = _pad_kv(k, v, bias, padded_kv)
+  grid = (bh, pl.cdiv(s_q, block_q), pl.cdiv(padded_kv, block_k))
+  q_spec, kv_spec, bias_spec, _ = _qkv_specs(block_q, block_k, d, heads)
   out, lse = pl.pallas_call(
-      functools.partial(_fwd_kernel, s_kv=s_kv, scale=1.0 / d**0.5,
-                        block_k=block_k),
+      functools.partial(_fwd_kernel, scale=1.0 / d**0.5),
       grid=grid,
-      in_specs=[q_spec, full_spec, full_spec, bias_spec],
+      in_specs=[q_spec, kv_spec, kv_spec, bias_spec],
       out_specs=[
-          pl.BlockSpec((1, block_q, d), lambda i, b: (i, b, 0)),
-          pl.BlockSpec((1, block_q, 1), lambda i, b: (i, b, 0)),
+          pl.BlockSpec((1, block_q, d), lambda i, b, j: (i, b, 0)),
+          pl.BlockSpec((1, block_q, 1), lambda i, b, j: (i, b, 0)),
       ],
       out_shape=[
           jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
           jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32),
+      ],
+      scratch_shapes=[
+          pltpu.VMEM((block_q, 1), jnp.float32),
+          pltpu.VMEM((block_q, 1), jnp.float32),
+          pltpu.VMEM((block_q, d), jnp.float32),
       ],
       interpret=_interpret(),
   )(q, k, v, bias)
@@ -193,8 +270,9 @@ def _flash_bwd(heads, res, cotangents):
   g, g_lse = cotangents
   bh, s_q, d = q.shape
   s_kv = k.shape[1]
-  block_q, _ = _block_sizes(s_q)
-  _, block_k = _block_sizes(s_kv)
+  block_q = min(_BLOCK_Q, s_q)
+  block_k, padded_kv = _kv_blocking(s_kv, _BLOCK_KV_BWD)
+  k, v, bias_padded = _pad_kv(k, v, bias, padded_kv)
   g = g.astype(q.dtype)
   # d(out)/dS = P(delta-terms); d(lse)/dS = P — so an lse cotangent folds
   # into the shared (dp - delta) factor as delta -= g_lse.
@@ -202,35 +280,44 @@ def _flash_bwd(heads, res, cotangents):
                   axis=-1, keepdims=True)  # [bh, s, 1]
   delta = delta - g_lse.astype(jnp.float32)
   scale = 1.0 / d**0.5
-  q_spec, full_spec, bias_spec = _specs(s_q, s_kv, d, heads, block_q)
-  q_full = pl.BlockSpec((1, s_q, d), lambda i, b: (i, 0, 0))
-  row_blocked = pl.BlockSpec((1, block_q, 1), lambda i, b: (i, b, 0))
-  row_full = pl.BlockSpec((1, s_q, 1), lambda i, b: (i, 0, 0))
 
+  # dq: grid (bh, q-blocks, kv-blocks), kv innermost.
+  q_spec, kv_spec, bias_spec, row_blocked = _qkv_specs(
+      block_q, block_k, d, heads)
   dq = pl.pallas_call(
-      functools.partial(_dq_kernel, s_kv=s_kv, scale=scale, block_k=block_k),
-      grid=(bh, pl.cdiv(s_q, block_q)),
-      in_specs=[q_spec, full_spec, full_spec, bias_spec, q_spec,
+      functools.partial(_dq_kernel, scale=scale),
+      grid=(bh, pl.cdiv(s_q, block_q), pl.cdiv(padded_kv, block_k)),
+      in_specs=[q_spec, kv_spec, kv_spec, bias_spec, q_spec,
                 row_blocked, row_blocked],
-      out_specs=pl.BlockSpec((1, block_q, d), lambda i, b: (i, b, 0)),
+      out_specs=pl.BlockSpec((1, block_q, d), lambda i, b, j: (i, b, 0)),
       out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+      scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
       interpret=_interpret(),
-  )(q, k, v, bias, g, lse, delta)
+  )(q, k, v, bias_padded, g, lse, delta)
 
-  k_spec = pl.BlockSpec((1, block_k, d), lambda i, b: (i, b, 0))
+  # dk/dv: grid (bh, kv-blocks, q-blocks), q innermost; the (k, v) block
+  # stays resident across the q sweep.
+  q_by_i = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+  kv_by_j = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+  bias_by_j = pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b // heads, 0, j))
+  row_by_i = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
   dk, dv = pl.pallas_call(
-      functools.partial(_dkv_kernel, s_q=s_q, scale=scale, block_q=block_q),
-      grid=(bh, pl.cdiv(s_kv, block_k)),
-      in_specs=[q_full, k_spec, k_spec, bias_spec, q_full,
-                row_full, row_full],
-      out_specs=[k_spec, k_spec],
+      functools.partial(_dkv_kernel, scale=scale),
+      grid=(bh, pl.cdiv(padded_kv, block_k), pl.cdiv(s_q, block_q)),
+      in_specs=[q_by_i, kv_by_j, kv_by_j, bias_by_j, q_by_i,
+                row_by_i, row_by_i],
+      out_specs=[kv_by_j, kv_by_j],
       out_shape=[
-          jax.ShapeDtypeStruct((bh, s_kv, d), q.dtype),
-          jax.ShapeDtypeStruct((bh, s_kv, d), q.dtype),
+          jax.ShapeDtypeStruct((bh, padded_kv, d), q.dtype),
+          jax.ShapeDtypeStruct((bh, padded_kv, d), q.dtype),
+      ],
+      scratch_shapes=[
+          pltpu.VMEM((block_k, d), jnp.float32),
+          pltpu.VMEM((block_k, d), jnp.float32),
       ],
       interpret=_interpret(),
-  )(q, k, v, bias, g, lse, delta)
-  return dq, dk, dv, jnp.zeros_like(bias)
+  )(q, k, v, bias_padded, g, lse, delta)
+  return dq, dk[:, :s_kv, :], dv[:, :s_kv, :], jnp.zeros_like(bias)
 
 
 _flash_pair.defvjp(_flash_fwd, _flash_bwd)

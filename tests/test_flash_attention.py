@@ -175,3 +175,31 @@ def test_make_flash_attention_rejects_seq_mesh():
   mesh = make_mesh(seq=2)
   with pytest.raises(ValueError, match='ring_flash'):
     make_flash_attention(mesh)
+
+
+@pytest.mark.parametrize('caps', [(128, 128), (256, 256)])
+def test_multiblock_kv_grid(monkeypatch, caps):
+  """Force the innermost kv grid dimension to take multiple steps (the
+  default caps of 4096/2048 make every CPU-sized test a single step, so
+  the cross-step scratch accumulation — init/rescale/finalize — would
+  otherwise go untested). The (256, 256) case also exercises the
+  non-divisor overshoot: s=600 pads to 640, which blocks as 256 x 3 =
+  768 with -inf-biased padding columns."""
+  from lddl_tpu.ops import flash_attention as fa
+  cap_fwd, cap_bwd = caps
+  monkeypatch.setattr(fa, '_BLOCK_KV_FWD', cap_fwd)
+  monkeypatch.setattr(fa, '_BLOCK_KV_BWD', cap_bwd)
+  q, k, v, mask = _inputs(1, 2, 600, 64, seed=11)
+  out = fa.flash_attention(q, k, v, mask)
+  ref = _dense_reference(q, k, v, mask)
+  np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                             rtol=2e-5, atol=2e-5)
+  cot = jnp.asarray(
+      np.random.default_rng(12).standard_normal(q.shape, dtype=np.float32))
+  gf = jax.grad(lambda q, k, v: jnp.sum(fa.flash_attention(q, k, v, mask)
+                                        * cot), argnums=(0, 1, 2))(q, k, v)
+  gd = jax.grad(lambda q, k, v: jnp.sum(_dense_reference(q, k, v, mask)
+                                        * cot), argnums=(0, 1, 2))(q, k, v)
+  for a, b, name in zip(gf, gd, 'qkv'):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                               atol=2e-4, err_msg=f'd{name}')
