@@ -110,7 +110,7 @@ def _read_row_range(path, a, b):
   return table.slice(a - int(offsets[groups[0]]), b - a)
 
 
-def _materialize_shard(files, ranges, out_path, compression='snappy'):
+def _materialize_shard(files, ranges, out_path, compression='default'):
   pieces = [
       _read_row_range(files[file_idx].path, a, b) for file_idx, a, b in ranges
   ]
@@ -123,6 +123,9 @@ def _materialize_shard(files, ranges, out_path, compression='snappy'):
     if not files:
       raise ValueError('cannot materialize a shard from zero input files')
     out = pq.read_schema(files[0].path).empty_table()
+  if compression == 'default':
+    from .pipeline.parquet_io import _default_compression
+    compression = _default_compression()
   pq.write_table(out, out_path, compression=compression)
   return out.num_rows
 

@@ -378,10 +378,35 @@ inline void encode_text(const Model& m, const char* s, int64_t len,
     encode_utf8(cp, w.bytes);
     w.cp_off.push_back(static_cast<int32_t>(w.bytes.size()));
   };
+  auto is_word_byte = [](unsigned char c) {
+    return (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9');
+  };
   while (i < len) {
     if (max_tokens > 0 &&
         out.size() - start_size >= static_cast<size_t>(max_tokens))
       break;
+    unsigned char c0 = static_cast<unsigned char>(s[i]);
+    // Fast path for the dominant case: runs of lowercase ASCII letters /
+    // digits append to the current word byte-for-byte (no decode,
+    // classification, or re-encode), and a single space flushes. The
+    // budget check above only changes value at flush boundaries, so
+    // skipping it within a run leaves the output byte-identical.
+    if (is_word_byte(c0)) {
+      if (w.cp_off.empty()) w.cp_off.push_back(0);
+      do {
+        w.bytes.push_back(static_cast<char>(c0));
+        w.cp_off.push_back(static_cast<int32_t>(w.bytes.size()));
+        ++i;
+        if (i >= len) break;
+        c0 = static_cast<unsigned char>(s[i]);
+      } while (is_word_byte(c0));
+      continue;
+    }
+    if (c0 == ' ') {
+      flush_word();
+      ++i;
+      continue;
+    }
     uint32_t cp = decode_utf8(s, len, i);
     if (cp == 0 || cp == 0xFFFD || is_control(cp)) continue;
     if (is_whitespace(cp)) { flush_word(); continue; }
