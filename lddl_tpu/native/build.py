@@ -102,6 +102,11 @@ def load_library():
       c.POINTER(c.c_uint32), c.POINTER(c.c_int32), c.c_int32, c.c_double,
       c.c_int32, c.POINTER(c.c_int64), c.c_int64
   ]
+  lib.lddl_mask_topk.restype = None
+  lib.lddl_mask_topk.argtypes = [
+      c.POINTER(c.c_uint64), c.POINTER(c.c_int64), c.c_int64, c.c_int64,
+      c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int32
+  ]
   _LIB_CACHE[path] = lib
   return lib
 

@@ -1,0 +1,69 @@
+// lddl_tpu native host kernel: per-row top-k selection for MLM masking.
+//
+// Replaces the numpy argpartition + take_along_axis + argsort + nonzero
+// chain in lddl_tpu/ops/masking.py's host path. Inputs are the tie-free
+// uint64 sort keys (positive-float bit patterns with the lane index in
+// the low bits — see mask_batch_host) and the per-row pick count k; the
+// output is the picked (row, col) index pairs in row-major ascending
+// order, exactly matching np.nonzero(picked) on the boolean matrix the
+// numpy path builds — so the downstream decide/replacement RNG draws
+// line up draw-for-draw and the masked output is bit-identical.
+
+#include <algorithm>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+void topk_rows(const uint64_t* keys, const int64_t* k, int64_t lo,
+               int64_t hi, int64_t l, const int64_t* out_offsets,
+               int64_t* out_cols) {
+  std::vector<uint64_t> scratch(l);
+  for (int64_t r = lo; r < hi; ++r) {
+    int64_t kk = k[r];
+    if (kk <= 0) continue;
+    if (kk > l) kk = l;
+    const uint64_t* row = keys + r * l;
+    // Keys are unique (lane index in the low bits), so the kth-smallest
+    // value is a clean threshold: one nth_element on values, then one
+    // ascending scan emits the picked columns already sorted.
+    std::copy(row, row + l, scratch.begin());
+    std::nth_element(scratch.begin(), scratch.begin() + (kk - 1),
+                     scratch.end());
+    uint64_t kth = scratch[kk - 1];
+    int64_t* out = out_cols + out_offsets[r];
+    for (int64_t j = 0; j < l; ++j)
+      if (row[j] <= kth) *out++ = j;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// keys: uint64[n*l] row-major; k: int64[n] (clamped to [0, l]);
+// out_offsets: int64[n+1] exclusive prefix sums of k (caller-computed);
+// out_cols: int64[out_offsets[n]]. Rows are emitted at their offset, so
+// the flat (repeat(rows, k), out_cols) pairing is row-major ascending.
+void lddl_mask_topk(const uint64_t* keys, const int64_t* k, int64_t n,
+                    int64_t l, const int64_t* out_offsets, int64_t* out_cols,
+                    int32_t nthreads) {
+  if (nthreads <= 1 || n <= 1) {
+    topk_rows(keys, k, 0, n, l, out_offsets, out_cols);
+    return;
+  }
+  if (nthreads > n) nthreads = static_cast<int32_t>(n);
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  for (int32_t t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min<int64_t>(n, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back(topk_rows, keys, k, lo, hi, l, out_offsets,
+                         out_cols);
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

@@ -15,6 +15,8 @@ RNG streams (bits differ; each is deterministic given its seed):
     batch padded to a bucket size to bound recompilation.
 """
 
+import os
+
 import numpy as np
 
 
@@ -115,6 +117,57 @@ def _special_and_valid(ids_shape_l, row_len, na):
   return valid
 
 
+_TOPK_NATIVE = None  # None = unprobed, False = unavailable
+
+
+def _select_topk(keys, k, n, l):
+  """(rows, cols, picked_bool): the k[r] smallest keys of each row, in
+  row-major ascending (row, col) order — identical to np.nonzero on the
+  picked matrix. Native C++ per-row nth_element when the toolchain is
+  available; numpy argpartition otherwise (same output)."""
+  global _TOPK_NATIVE
+  if _TOPK_NATIVE is None:
+    try:
+      from ..native.build import load_library
+      _TOPK_NATIVE = load_library()
+    except Exception:  # no toolchain: fall back quietly, like pairing
+      _TOPK_NATIVE = False
+  if _TOPK_NATIVE:
+    import ctypes
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    # Clamp here, before the offsets are sized from k — the C++ clamp
+    # alone would leave out-of-range rows with unwritten output slots.
+    k64 = np.clip(np.asarray(k, dtype=np.int64), 0, l)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(k64, out=offsets[1:])
+    cols = np.empty(int(offsets[-1]), dtype=np.int64)
+    # Modest thread cap (wordpiece precedent): the executor already runs
+    # one worker process per core, so per-call threads must not multiply
+    # against that.
+    _TOPK_NATIVE.lddl_mask_topk(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        k64.ctypes.data_as(i64p), n, l, offsets.ctypes.data_as(i64p),
+        cols.ctypes.data_as(i64p), min(8, os.cpu_count() or 1))
+    rows = np.repeat(np.arange(n, dtype=np.int64), k64)
+    picked = np.zeros((n, l), dtype=bool)
+    picked[rows, cols] = True
+    return rows, cols, picked
+  kmax = int(k.max())
+  picked = np.zeros((n, l), dtype=bool)
+  if kmax < l:
+    part = np.argpartition(keys, kmax, axis=1)[:, :kmax]
+    vals = np.take_along_axis(keys, part, axis=1)
+    sel = np.take_along_axis(part, np.argsort(vals, axis=1), axis=1)
+  else:
+    sel = np.argsort(keys, axis=1)
+  in_k = np.arange(sel.shape[1], dtype=np.int64)[None, :] < k[:, None]
+  rr, cc = np.nonzero(in_k)
+  picked[rr, sel[rr, cc]] = True
+  pr, pc = np.nonzero(picked)
+  return pr, pc, picked
+
+
 def mask_batch_host(ids_mat, row_len, na, *, masked_lm_ratio, vocab_size,
                     mask_id, np_rng, max_predictions=None):
   """Vectorized numpy masking. Returns (masked_mat, picked_mask).
@@ -147,21 +200,16 @@ def mask_batch_host(ids_mat, row_len, na, *, masked_lm_ratio, vocab_size,
   lane_bits = max(1, (l - 1)).bit_length()
   keys = (u.view(np.uint64) & ~np.uint64((1 << lane_bits) - 1)
           | np.arange(l, dtype=np.uint64)[None, :])
-  kmax = int(k.max())
-  picked = np.zeros((n, l), dtype=bool)
-  if kmax < l:
-    part = np.argpartition(keys, kmax, axis=1)[:, :kmax]
-    vals = np.take_along_axis(keys, part, axis=1)
-    sel = np.take_along_axis(part, np.argsort(vals, axis=1), axis=1)
-  else:
-    sel = np.argsort(keys, axis=1)
-  in_k = np.arange(sel.shape[1], dtype=np.int64)[None, :] < k[:, None]
-  rr, cc = np.nonzero(in_k)
-  picked[rr, sel[rr, cc]] = True
-  picked &= valid
+  # Select the k smallest keys per row. Invalid lanes carry the float 2.0
+  # bit pattern — larger than any valid [0, 1) draw — and k is clamped to
+  # the per-row valid count above, so the selection can never touch an
+  # invalid lane. The native path (nth_element per row, C++) and the
+  # numpy path (argpartition) produce the identical picked set, emitted
+  # in row-major ascending order so the downstream decide/replacement
+  # draws line up draw-for-draw either way.
+  pr, pc, picked = _select_topk(keys, k, n, l)
   # decide / replacement draws only at picked positions (~ratio of the
   # matrix) instead of dense (n, l) matrices.
-  pr, pc = np.nonzero(picked)
   decide = np_rng.random(len(pr))
   rand_ids = np_rng.integers(0, vocab_size, len(pr), dtype=np.int32)
   masked = ids_mat.copy()

@@ -232,3 +232,35 @@ class TestMaskingOps:
         ids, row_len, na, masked_lm_ratio=0.5, vocab_size=30, mask_id=4,
         seed=3, backend='host', max_predictions=7)
     assert (picked.sum(axis=1) == 7).all()
+
+
+class TestTopkSelection:
+
+  def test_native_matches_numpy(self):
+    """The C++ per-row top-k (native/src/masking.cpp) must emit exactly
+    what the numpy argpartition path emits — same picked set, same
+    row-major order — or the downstream decide/replacement RNG draws
+    would shift and masked outputs would differ by backend."""
+    from lddl_tpu.ops import masking as M
+    rng = np.random.default_rng(123)
+    for _ in range(30):
+      n = int(rng.integers(1, 300))
+      l = int(rng.choice([16, 64, 128, 131, 200]))
+      u = rng.random((n, l))
+      lane_bits = max(1, (l - 1)).bit_length()
+      keys = (u.view(np.uint64) & ~np.uint64((1 << lane_bits) - 1)
+              | np.arange(l, dtype=np.uint64)[None, :])
+      k = rng.integers(0, l + 1, n)
+      old = M._TOPK_NATIVE
+      try:
+        M._TOPK_NATIVE = None
+        pr1, pc1, p1 = M._select_topk(keys, k, n, l)
+        if not M._TOPK_NATIVE:
+          pytest.skip('native toolchain unavailable')
+        M._TOPK_NATIVE = False
+        pr2, pc2, p2 = M._select_topk(keys, k, n, l)
+      finally:
+        M._TOPK_NATIVE = old
+      assert np.array_equal(pr1, pr2)
+      assert np.array_equal(pc1, pc2)
+      assert np.array_equal(p1, p2)
