@@ -28,6 +28,40 @@ WORDS = [
 ]
 
 
+def make_nsp_sample(r, bin_id, bin_size, with_mask=False, serializer=None):
+  """One NSP-pair row whose num_tokens lands inside bin_id's range.
+
+  ``serializer`` controls the masked_lm_positions wire format (defaults
+  to this repo's serialize_np_array; interop tests inject the
+  reference's np.save-based serializer, which is byte-compatible)."""
+  import numpy as np
+  lo = bin_id * bin_size + 1
+  hi = (bin_id + 1) * bin_size
+  nt = r.randrange(max(lo, 8), hi + 1)
+  na = r.randrange(2, nt - 3 - 2)
+  nb = nt - 3 - na
+  a = [r.choice(WORDS) for _ in range(na)]
+  b = [r.choice(WORDS) for _ in range(nb)]
+  row = {
+      'A': ' '.join(a),
+      'B': ' '.join(b),
+      'is_random_next': bool(r.getrandbits(1)),
+      'num_tokens': nt,
+  }
+  if with_mask:
+    # Mask 2 content positions of the assembled [CLS] A [SEP] B [SEP] seq.
+    cand = list(range(1, 1 + na)) + list(range(2 + na, 2 + na + nb))
+    picked = sorted(r.sample(cand, 2))
+    seq = ['[CLS]'] + a + ['[SEP]'] + b + ['[SEP]']
+    if serializer is None:
+      from lddl_tpu.core.utils import serialize_np_array
+      serializer = serialize_np_array
+    row['masked_lm_positions'] = serializer(
+        np.asarray(picked, dtype=np.uint16))
+    row['masked_lm_labels'] = ' '.join(seq[p] for p in picked)
+  return row
+
+
 @pytest.fixture(scope='session')
 def tiny_vocab(tmp_path_factory):
   """A minimal WordPiece vocab covering the tmp_corpus words."""

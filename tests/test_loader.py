@@ -6,7 +6,6 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 import pytest
 
-from lddl_tpu.core.utils import serialize_np_array
 from lddl_tpu.loader import (
     BinnedIterator,
     ParquetShardDataset,
@@ -15,36 +14,16 @@ from lddl_tpu.loader import (
 )
 from lddl_tpu.loader.bert import IGNORE_INDEX, split_into_micro_batches
 
-from conftest import WORDS
+from conftest import make_nsp_sample
 
 BIN_SIZE = 64
 
 
 def _make_sample(r, bin_id, with_mask=False):
-  """One NSP pair whose num_tokens lands inside bin_id's range."""
-  lo = bin_id * BIN_SIZE + 1
-  hi = (bin_id + 1) * BIN_SIZE
-  nt = r.randrange(max(lo, 8), hi + 1)
-  na = r.randrange(2, nt - 3 - 2)
-  nb = nt - 3 - na
-  tok = lambda: r.choice(WORDS)
-  a = [tok() for _ in range(na)]
-  b = [tok() for _ in range(nb)]
-  row = {
-      'A': ' '.join(a),
-      'B': ' '.join(b),
-      'is_random_next': bool(r.getrandbits(1)),
-      'num_tokens': nt,
-  }
-  if with_mask:
-    # Mask 2 content positions of the assembled [CLS] A [SEP] B [SEP] seq.
-    cand = list(range(1, 1 + na)) + list(range(2 + na, 2 + na + nb))
-    picked = sorted(r.sample(cand, 2))
-    seq = ['[CLS]'] + a + ['[SEP]'] + b + ['[SEP]']
-    row['masked_lm_positions'] = serialize_np_array(
-        np.asarray(picked, dtype=np.uint16))
-    row['masked_lm_labels'] = ' '.join(seq[p] for p in picked)
-  return row
+  """One NSP pair whose num_tokens lands inside bin_id's range (shared
+  generator in conftest; interop tests reuse it with the reference's
+  serializer injected)."""
+  return make_nsp_sample(r, bin_id, BIN_SIZE, with_mask=with_mask)
 
 
 def _schema(with_mask):
