@@ -1,0 +1,126 @@
+"""Long-context single-chip training: real BERT train steps at s >= 8192.
+
+The grid-blocked flash kernel removed the sequence-length cap on
+attention memory; this bench shows what that buys in-model: full
+BERT-base training steps (fwd + bwd + adamw update) at sequence lengths
+the dense path cannot represent at all (its [b, h, s, s] score tensors
+stop compiling past 4k — see attention_bench). Configuration per step:
+``attention_impl='flash'``, remat on, masked-only MLM head (the b*s*V
+logits chain would otherwise dominate memory at long s).
+
+Batches are synthetic (uniform ids, 15% masked positions) because the
+BERT data pipeline tops out at seq-512 pairs by design; the model,
+sharding, scan-window dispatch amortization, and optimizer are the real
+training stack (`lddl_tpu.parallel.make_scan_train_step`). Writes one
+line per sequence length; OOM is recorded as the datapoint.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _synthetic_batch(rng, batch, seq_len, vocab, max_predictions):
+  from lddl_tpu.loader.bert import IGNORE_INDEX
+  n_mask = max_predictions
+  ids = rng.integers(5, vocab, (batch, seq_len), dtype=np.int32)
+  labels = np.full((batch, seq_len), IGNORE_INDEX, np.int32)
+  for b in range(batch):
+    pos = rng.choice(np.arange(1, seq_len - 1), size=n_mask, replace=False)
+    labels[b, pos] = ids[b, pos]
+    ids[b, pos] = 4  # [MASK]
+  return {
+      'input_ids': ids,
+      'token_type_ids': np.zeros((batch, seq_len), np.int32),
+      'attention_mask': np.ones((batch, seq_len), np.int32),
+      'labels': labels,
+      'next_sentence_labels': rng.integers(0, 2, (batch,), dtype=np.int32),
+  }
+
+
+def main(argv=None):
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument('--seqs', default='8192,16384,32768')
+  p.add_argument('--batch', type=int, default=1)
+  p.add_argument('--model', default='base')
+  p.add_argument('--scan-steps', type=int, default=4)
+  p.add_argument('--windows', type=int, default=3)
+  p.add_argument('--max-predictions', type=int, default=None,
+                 help='default: ceil(0.15 * seq_len)')
+  p.add_argument('--out', default=None)
+  args = p.parse_args(argv)
+
+  import jax
+  import optax
+
+  from lddl_tpu.models import BertConfig, BertForPretraining
+  from lddl_tpu.parallel import make_mesh
+  from lddl_tpu.parallel.train import (init_params, make_scan_train_step,
+                                       stack_batch_window)
+
+  sizes = {'base': (768, 12, 12, 3072), 'large': (1024, 24, 16, 4096)}
+  hidden, layers, heads, inter = sizes[args.model]
+  vocab = 30528
+  mesh = make_mesh()
+  rng = np.random.default_rng(0)
+  lines = [('# long-context single-chip train steps: '
+            f'{args.model}, batch={args.batch}, flash+remat+masked-only '
+            f'head, scan={args.scan_steps}, median of {args.windows} '
+            'windows'),
+           '# s | max_pred | ms/step | tokens/s | result']
+  print('\n'.join(lines), flush=True)
+
+  for s in [int(x) for x in args.seqs.split(',')]:
+    max_pred = args.max_predictions or int(np.ceil(0.15 * s))
+    cfg = BertConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_heads=heads, intermediate_size=inter,
+        max_position_embeddings=s, attention_impl='flash', remat=True)
+    model = BertForPretraining(cfg)
+    tx = optax.adamw(1e-4)
+    try:
+      params = init_params(model, mesh, jax.random.key(7), seq_len=128)
+      opt_state = jax.jit(tx.init, out_shardings=None)(params)
+      scan = make_scan_train_step(model, tx, mesh,
+                                  max_predictions=max_pred)
+      batches = [
+          _synthetic_batch(rng, args.batch, s, vocab, max_pred)
+          for _ in range(args.scan_steps)
+      ]
+      window = stack_batch_window(batches, mesh)
+      key = jax.random.key(11)
+      params2, opt2, metrics = scan(params, opt_state, key, window)
+      float(metrics['loss'])  # sync (compile + first window)
+      times = []
+      for _ in range(args.windows):
+        t0 = time.perf_counter()
+        params2, opt2, metrics = scan(params2, opt2, key, window)
+        float(metrics['loss'])  # device->host sync
+        times.append(time.perf_counter() - t0)
+      ms = float(np.median(times)) * 1000 / args.scan_steps
+      toks = args.batch * s / (ms / 1000)
+      row = f'{s:6d} | {max_pred:6d} | {ms:9.1f} | {toks:9.0f} | ok'
+    except Exception as e:  # noqa: BLE001 — OOM is the datapoint
+      msg = str(e)
+      if ('RESOURCE_EXHAUSTED' in msg or 'Ran out of memory' in msg
+          or 'hbm capacity' in msg):
+        row = f'{s:6d} | {max_pred:6d} |       OOM |       OOM | oom'
+      else:
+        print(f'ERR at s={s}: {msg[:400]}', file=sys.stderr, flush=True)
+        row = f'{s:6d} | {max_pred:6d} |       ERR |       ERR | err'
+    lines.append(row)
+    print(row, flush=True)
+    if args.out:
+      # Rewrite after every row so a hard process kill at a later size
+      # (HBM abort, dropped tunnel) keeps the finished datapoints.
+      with open(args.out, 'w', encoding='utf-8') as f:
+        f.write('\n'.join(lines) + '\n')
+
+
+if __name__ == '__main__':
+  main()
