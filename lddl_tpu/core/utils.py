@@ -168,6 +168,52 @@ def serialize_u16_batch(values, offsets):
   ]
 
 
+def u16_batch_binary_parts(values, offsets):
+  """Batched, fully-vectorized form of :func:`serialize_u16_batch` that
+  returns Arrow-binary-column parts instead of a Python list of bytes:
+  ``(value_offsets int64 [n+1], data uint8)`` where row ``i``'s value is
+  the ``np.save``-compatible serialization of
+  ``values[offsets[i]:offsets[i+1]]``. The caller wraps these in
+  ``pa.BinaryArray.from_buffers`` — no per-row Python objects exist at
+  any point (the per-row list of ``serialize_u16_batch`` was a measured
+  hot spot of the dup=5 preprocess path)."""
+  values = np.ascontiguousarray(values, dtype='<u2')
+  offsets = np.asarray(offsets, dtype=np.int64)
+  n = len(offsets) - 1
+  if n <= 0:
+    return np.zeros(1, dtype=np.int64), np.zeros(0, dtype=np.uint8)
+  # Like serialize_u16_batch, offsets may describe a sub-span of values;
+  # normalize so the payload scatter below can assume a 0-based span.
+  if offsets[0] != 0 or offsets[-1] != len(values):
+    values = np.ascontiguousarray(values[offsets[0]:offsets[-1]])
+    offsets = offsets - offsets[0]
+  counts = np.diff(offsets)
+  uniq = np.unique(counts)
+  hdr_bytes = {int(c): np.frombuffer(_npy_header('<u2', int(c)), np.uint8)
+               for c in uniq}
+  hdr_len = np.zeros(int(uniq.max()) + 1, dtype=np.int64)
+  for c, h in hdr_bytes.items():
+    hdr_len[c] = len(h)
+  hl = hdr_len[counts]
+  row_bytes = hl + 2 * counts
+  boffs = np.zeros(n + 1, dtype=np.int64)
+  np.cumsum(row_bytes, out=boffs[1:])
+  data = np.empty(int(boffs[-1]), dtype=np.uint8)
+  for c, h in hdr_bytes.items():
+    rows = np.nonzero(counts == c)[0]
+    idx = boffs[rows][:, None] + np.arange(len(h), dtype=np.int64)[None, :]
+    data[idx.ravel()] = np.tile(h, len(rows))
+  # Payload scatter: the flat values buffer is already in row order, so
+  # each payload byte lands at (row's payload start) + (its offset within
+  # the row's payload).
+  payload = values.view(np.uint8)
+  nbytes = 2 * counts
+  target = (np.repeat(boffs[:n] + hl - 2 * offsets[:n], nbytes)
+            + np.arange(len(payload), dtype=np.int64))
+  data[target] = payload
+  return boffs, data
+
+
 _NPY_1D_HEADER_RE = re.compile(
     rb"^\{'descr': '([^']+)', 'fortran_order': False, "
     rb"'shape': \((\d+),\), \}\s*\n$")

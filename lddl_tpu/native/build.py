@@ -107,6 +107,14 @@ def load_library():
       c.POINTER(c.c_uint64), c.POINTER(c.c_int64), c.c_int64, c.c_int64,
       c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int32
   ]
+  lib.lddl_mask_partition.restype = None
+  lib.lddl_mask_partition.argtypes = [
+      c.POINTER(c.c_int32), c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+      c.c_int64, c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+      c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_uint64, c.c_int32,
+      c.c_int32, c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+      c.POINTER(c.c_uint16), c.POINTER(c.c_int32), c.c_int32
+  ]
   _LIB_CACHE[path] = lib
   return lib
 

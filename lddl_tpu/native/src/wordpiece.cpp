@@ -203,6 +203,14 @@ struct Model {
   // Unique instance tag (never reused, unlike the heap address) so
   // thread-local word caches can detect a model switch.
   uint64_t gen = ++g_model_gen;
+  // Decode arena: per id, ' ' + token bytes padded to kDecodeStride so the
+  // decode hot loop is one unconditional fixed-size copy (tokens longer
+  // than kDecodeStride - 1 take the slow path; none exist in BERT vocabs,
+  // where entries are <= max_input_chars wordpieces but practically < 30
+  // bytes). decode_lens[id] = token byte length (without the space).
+  static constexpr int32_t kDecodeStride = 32;
+  std::vector<char> decode_arena;
+  std::vector<int32_t> decode_lens;
 };
 
 // Per-thread memo of normalized-word bytes -> wordpiece ids. Natural text
@@ -600,6 +608,21 @@ void* lddl_wp_create(const char* vocab_blob, const int64_t* offsets,
   m->unk_id = unk_id;
   m->lowercase = lowercase != 0;
   m->max_input_chars = max_input_chars;
+  // One extra stride of zero padding: the first-of-sequence fast path
+  // reads kDecodeStride bytes from slot + 1, which for the last id would
+  // otherwise run one byte past the arena.
+  m->decode_arena.assign(
+      static_cast<size_t>(n + 1) * Model::kDecodeStride, 0);
+  m->decode_lens.resize(n);
+  for (int32_t i = 0; i < n; ++i) {
+    char* slot = m->decode_arena.data() +
+                 static_cast<size_t>(i) * Model::kDecodeStride;
+    slot[0] = ' ';
+    size_t len = std::min<size_t>(m->tokens[i].size(),
+                                  Model::kDecodeStride - 1);
+    std::memcpy(slot + 1, m->tokens[i].data(), len);
+    m->decode_lens[i] = static_cast<int32_t>(m->tokens[i].size());
+  }
   return m;
 }
 
@@ -731,20 +754,38 @@ int64_t lddl_decode_join(void* model, const int32_t* ids,
                          char* out_data, int64_t cap_data,
                          int32_t* out_offsets) {
   const Model& m = *static_cast<Model*>(model);
+  const int32_t nvocab = static_cast<int32_t>(m.tokens.size());
+  const char* arena = m.decode_arena.data();
+  const int32_t* lens = m.decode_lens.data();
+  constexpr int32_t kStride = Model::kDecodeStride;
   int64_t pos = 0;
   out_offsets[0] = 0;
   for (int64_t s = 0; s < n_seqs; ++s) {
     for (int64_t k = offsets[s]; k < offsets[s + 1]; ++k) {
-      std::string_view tok =
-          (ids[k] >= 0 && ids[k] < static_cast<int32_t>(m.tokens.size()))
-              ? m.tokens[ids[k]]
-              : std::string_view("[UNK]");
-      int64_t need = static_cast<int64_t>(tok.size()) +
-                     (k > offsets[s] ? 1 : 0);
-      if (pos + need > cap_data) return -1;
-      if (k > offsets[s]) out_data[pos++] = ' ';
-      std::memcpy(out_data + pos, tok.data(), tok.size());
-      pos += static_cast<int64_t>(tok.size());
+      const int32_t id = ids[k];
+      const bool first = (k == offsets[s]);
+      if (id >= 0 && id < nvocab && lens[id] < kStride - 1 &&
+          pos + kStride + 1 <= cap_data) {
+        // Hot path: one unconditional fixed-width copy of the arena slot
+        // (' ' + token, zero-padded); the advance truncates the padding.
+        // First-of-sequence reads from slot+1 to skip the space (the
+        // trailing arena pad byte makes the over-read safe).
+        std::memcpy(out_data + pos,
+                    arena + static_cast<size_t>(id) * kStride + (first ? 1 : 0),
+                    kStride);
+        pos += lens[id] + (first ? 0 : 1);
+      } else {
+        // Exact path: long/invalid ids, or too close to the buffer end
+        // for the wide store (callers leave slack, so this is rare).
+        std::string_view tok = (id >= 0 && id < nvocab)
+                                   ? m.tokens[id]
+                                   : std::string_view("[UNK]");
+        int64_t need = static_cast<int64_t>(tok.size()) + (first ? 0 : 1);
+        if (pos + need > cap_data) return -1;
+        if (!first) out_data[pos++] = ' ';
+        std::memcpy(out_data + pos, tok.data(), tok.size());
+        pos += static_cast<int64_t>(tok.size());
+      }
     }
     // Arrow string offsets are int32; joined output past 2 GiB must fail
     // loudly (callers split the batch), never wrap into corrupt offsets.

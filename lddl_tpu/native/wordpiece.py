@@ -167,9 +167,11 @@ class NativeWordPiece:
       used = ids[:n_ids]
       in_range = (used >= 0) & (used < len(self._lens_lut))
       lens = np.where(in_range, self._lens_lut[np.where(in_range, used, 0)], 5)
-      cap = int(lens.sum()) + n_ids + 16
+      # +48 slack keeps the native wide-store fast path active through
+      # the final tokens (it needs kDecodeStride+1 bytes of headroom).
+      cap = int(lens.sum()) + n_ids + 48
     else:
-      cap = 16
+      cap = 48
     out_data = np.empty(cap, dtype=np.uint8)
     out_offsets = np.empty(n + 1, dtype=np.int32)
     total = self._lib.lddl_decode_join(

@@ -13,5 +13,6 @@ from .masking import (  # noqa: F401
     mask_batch_device,
     mask_batch_host,
     mask_partition_device,
+    mask_partition_host,
     resolve_mask_backend,
 )

@@ -306,12 +306,12 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
   contiguous-range pair planning, one batched masking call on the
   accelerator, and zero-copy Arrow column assembly.
   """
-  from ..ops import assemble_pair_matrix, mask_batch
   from ..ops import masking as _masking_ops
-  from ..core.utils import serialize_u16_batch
+  from ..core.utils import u16_batch_binary_parts
   from .pairing import plan_pairs_partition
 
-  from ..ops.masking import mask_partition_device, resolve_mask_backend
+  from ..ops.masking import (mask_partition_device, mask_partition_host,
+                             resolve_mask_backend)
 
   docs = encode_documents(doc_texts, tokenizer,
                           sentence_backend=cfg.sentence_backend)
@@ -328,6 +328,12 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
   na = (a_ranges[:, 1] - a_ranges[:, 0]).astype(np.int64)
   nb = (b_ranges[:, 1] - b_ranges[:, 0]).astype(np.int64)
   row_len = na + nb + 3
+  if n and int(row_len.max()) > cfg.target_seq_length:
+    # Fail loudly at preprocess time (the padded-matrix path used to
+    # enforce this in assemble_pair_matrix): oversized rows would break
+    # downstream binning/collate shape assumptions silently.
+    raise ValueError(f'pair of {int(row_len.max())} tokens exceeds '
+                     f'target_seq_length {cfg.target_seq_length}')
   mask_mode = resolve_mask_backend(cfg.mask_backend) if cfg.masking else None
   offs_a = np.zeros(n + 1, dtype=np.int64)
   np.cumsum(na, out=offs_a[1:])
@@ -335,21 +341,15 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
   np.cumsum(nb, out=offs_b[1:])
 
   if mask_mode == 'host':
-    # Padded-matrix path: assemble + mask + ragged re-extraction, all numpy.
-    mat, row_len32, na32 = assemble_pair_matrix(
-        flat_ids, a_ranges, b_ranges, tokenizer.cls_token_id,
-        tokenizer.sep_token_id, cfg.target_seq_length)
-    masked, picked = mask_batch(
-        mat, row_len32, na32, masked_lm_ratio=cfg.masked_lm_ratio,
+    # Fused ragged path: one native pass gathers A/B, draws k Fisher-
+    # Yates picks per row from a counter-based Philox stream, applies
+    # 80/10/10, and emits sorted positions + label ids — no padded id
+    # matrix, no dense [N, L] uniform draws (see ops/masking.py
+    # mask_partition_host; numpy fallback is bit-identical).
+    flat_a, flat_b, ci, label_ids, k = mask_partition_host(
+        flat_ids, a_ranges, b_ranges, masked_lm_ratio=cfg.masked_lm_ratio,
         vocab_size=tokenizer.vocab_size, mask_id=tokenizer.mask_token_id,
-        seed=mask_seed, backend='host')
-    ra, ca = _masking_ops.ragged_indices(na)
-    flat_a = masked[ra, ca + 1]
-    rb, cb = _masking_ops.ragged_indices(nb)
-    flat_b = masked[rb, cb + 2 + na[rb]]
-    ri, ci = np.nonzero(picked)  # row-major -> positions sorted per row
-    label_ids = mat[ri, ci].astype(np.int32)
-    k = picked.sum(axis=1).astype(np.int64)
+        seed=mask_seed, offs_a=offs_a, offs_b=offs_b)
   else:
     # Ragged gather straight from the flat partition ids (no id matrix).
     ra, ca = _masking_ops.ragged_indices(na)
@@ -391,8 +391,17 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
   if cfg.masking:
     offs_l = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(k, out=offs_l[1:])
-    cols['masked_lm_positions'] = pa.array(
-        serialize_u16_batch(ci.astype('<u2'), offs_l), type=pa.binary())
+    boffs, bdata = u16_batch_binary_parts(ci, offs_l)
+    if int(boffs[-1]) > np.iinfo(np.int32).max:
+      # Same loud failure as the string columns (decode_join_buffers):
+      # Arrow binary offsets are int32 — a silent astype wrap would
+      # write corrupt shards.
+      raise ValueError(
+          'masked_lm_positions column exceeds 2 GiB (Arrow int32 offset '
+          'limit); split the partition into smaller batches')
+    cols['masked_lm_positions'] = pa.BinaryArray.from_buffers(
+        pa.binary(), n, [None, pa.py_buffer(boffs.astype(np.int32)),
+                         pa.py_buffer(bdata)])
     cols['masked_lm_labels'] = _string_column(tokenizer, label_ids, offs_l)
   return pa.table(cols)
 

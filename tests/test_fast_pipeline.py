@@ -234,6 +234,111 @@ class TestMaskingOps:
     assert (picked.sum(axis=1) == 7).all()
 
 
+class TestRaggedMaskParity:
+
+  def test_native_matches_numpy_bitwise(self):
+    """The fused C++ partition masking (lddl_mask_partition) and its
+    numpy fallback implement one shared Philox/Fisher-Yates draw spec;
+    all five outputs must be bit-identical, or shard bits would depend
+    on toolchain availability."""
+    from lddl_tpu.ops import masking as M
+    rng = np.random.default_rng(77)
+    for trial in range(10):
+      flat = rng.integers(5, 30000, 4000).astype(np.int32)
+      n = int(rng.integers(1, 120))
+      a0 = rng.integers(0, 3000, n)
+      b0 = rng.integers(0, 3000, n)
+      a_ranges = np.stack([a0, a0 + rng.integers(1, 80, n)], 1)
+      b_ranges = np.stack([b0, b0 + rng.integers(1, 80, n)], 1)
+      kw = dict(masked_lm_ratio=0.15, vocab_size=30000, mask_id=4,
+                seed=int(rng.integers(0, 2**63)),
+                max_predictions=None if trial % 2 else 12)
+      old = M._TOPK_NATIVE
+      try:
+        M._TOPK_NATIVE = None
+        nat = M.mask_partition_host(flat, a_ranges, b_ranges, **kw)
+        if not M._TOPK_NATIVE:
+          pytest.skip('native toolchain unavailable')
+        M._TOPK_NATIVE = False
+        fb = M.mask_partition_host(flat, a_ranges, b_ranges, **kw)
+      finally:
+        M._TOPK_NATIVE = old
+      for name, x, y in zip(('flat_a', 'flat_b', 'pos', 'labels', 'k'),
+                            nat, fb):
+        assert x.dtype == y.dtype, name
+        assert np.array_equal(x, y), name
+
+  def test_structure_and_determinism(self):
+    from lddl_tpu.ops import mask_partition_host
+    flat = (np.arange(2000, dtype=np.int32) * 7) % 25000 + 10
+    a_ranges = np.array([[0, 30], [100, 160], [500, 505]], np.int64)
+    b_ranges = np.array([[700, 740], [900, 910], [1200, 1260]], np.int64)
+    kw = dict(masked_lm_ratio=0.15, vocab_size=25000, mask_id=4, seed=3)
+    fa1, fb1, pos1, lab1, k1 = mask_partition_host(flat, a_ranges, b_ranges,
+                                                   **kw)
+    fa2, fb2, pos2, lab2, k2 = mask_partition_host(flat, a_ranges, b_ranges,
+                                                   **kw)
+    assert np.array_equal(fa1, fa2) and np.array_equal(pos1, pos2)
+    na = a_ranges[:, 1] - a_ranges[:, 0]
+    nb = b_ranges[:, 1] - b_ranges[:, 0]
+    row_len = na + nb + 3
+    assert np.array_equal(
+        k1, np.minimum(np.maximum(1, np.rint(row_len * 0.15)), na + nb))
+    offs = np.zeros(4, np.int64)
+    np.cumsum(k1, out=offs[1:])
+    for r in range(3):
+      p = pos1[offs[r]:offs[r + 1]].astype(np.int64)
+      assert (np.diff(p) > 0).all()  # sorted, unique
+      assert (p > 0).all() and (p != 1 + na[r]).all() \
+          and (p < row_len[r] - 1).all()
+    # unpicked positions keep their original ids
+    offs_a = np.zeros(4, np.int64)
+    np.cumsum(na, out=offs_a[1:])
+    orig_a = np.concatenate(
+        [flat[a_ranges[r, 0]:a_ranges[r, 1]] for r in range(3)])
+    changed = np.nonzero(orig_a != fa1)[0]
+    picked_a = []
+    ri = np.repeat(np.arange(3), k1)
+    in_a = pos1.astype(np.int64) - 1 < na[ri]
+    picked_a = offs_a[ri[in_a]] + pos1[in_a].astype(np.int64) - 1
+    assert set(changed) <= set(picked_a.tolist())
+
+
+class TestPositionsSerialization:
+
+  def test_binary_parts_match_serialize_u16_batch(self):
+    from lddl_tpu.core.utils import serialize_u16_batch, u16_batch_binary_parts
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+      n = int(rng.integers(1, 40))
+      counts = rng.integers(0, 30, n)
+      offs = np.zeros(n + 1, np.int64)
+      np.cumsum(counts, out=offs[1:])
+      vals = rng.integers(0, 512, int(offs[-1])).astype('<u2')
+      expected = serialize_u16_batch(vals, offs)
+      boffs, data = u16_batch_binary_parts(vals, offs)
+      raw = data.tobytes()
+      got = [raw[boffs[i]:boffs[i + 1]] for i in range(n)]
+      assert got == expected
+
+  def test_empty(self):
+    from lddl_tpu.core.utils import u16_batch_binary_parts
+    boffs, data = u16_batch_binary_parts(np.zeros(0, '<u2'),
+                                         np.zeros(1, np.int64))
+    assert len(boffs) == 1 and len(data) == 0
+
+  def test_sub_span_offsets(self):
+    """Offsets describing a sub-span of values (like serialize_u16_batch
+    supports) must serialize that span, not crash or shift."""
+    from lddl_tpu.core.utils import serialize_u16_batch, u16_batch_binary_parts
+    vals = np.arange(10).astype('<u2')
+    offs = np.array([2, 5, 9], np.int64)
+    expected = serialize_u16_batch(vals, offs)
+    boffs, data = u16_batch_binary_parts(vals, offs)
+    raw = data.tobytes()
+    assert [raw[boffs[i]:boffs[i + 1]] for i in range(2)] == expected
+
+
 class TestTopkSelection:
 
   def test_native_matches_numpy(self):
