@@ -1,0 +1,76 @@
+"""BART loader throughput: drain the noising collate, report samples/s.
+
+The BART collate is the heaviest in the framework — it tokenizes raw
+sentences and applies text-infilling + sentence-permutation noise at
+load time (reference ``lddl/torch/datasets.py`` BART path) — so its
+sustained rate bounds how many chips one feeder core can keep busy.
+Prints one JSON line; commit the output under ``benchmarks/results/``.
+
+Run from the repo root::
+
+  python benchmarks/bart_loader_bench.py --path bart_sink/ \
+      --vocab-file benchmarks/assets/bench_vocab_30522.txt --iters 1500
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument('--path', required=True)
+  p.add_argument('--vocab-file', required=True)
+  p.add_argument('--batch-size', type=int, default=64)
+  p.add_argument('--max-seq-length', type=int, default=128)
+  p.add_argument('--iters', type=int, default=1500)
+  p.add_argument('--warmup', type=int, default=20)
+  p.add_argument('--num-workers', type=int, default=0)
+  args = p.parse_args()
+
+  from lddl_tpu.loader import get_bart_pretrain_data_loader
+
+  def make_loader(epoch):
+    return get_bart_pretrain_data_loader(
+        args.path,
+        vocab_file=args.vocab_file,
+        batch_size_per_rank=args.batch_size,
+        max_seq_length=args.max_seq_length,
+        start_epoch=epoch,
+        num_workers=args.num_workers,
+    )
+
+  n = 0
+  t0 = None
+  epoch = 0
+  while n < args.iters:
+    for batch in make_loader(epoch):
+      assert batch['input_ids'].shape[0] == args.batch_size
+      assert batch['labels'].shape == batch['input_ids'].shape
+      n += 1
+      if n == args.warmup:
+        t0 = time.perf_counter()
+      if n >= args.iters + args.warmup:
+        break
+    epoch += 1
+    if epoch > 100:
+      raise RuntimeError('dataset too small for the requested --iters')
+    if n >= args.iters + args.warmup:
+      break
+  dt = time.perf_counter() - t0
+  measured = n - args.warmup
+  print(json.dumps({
+      'metric': 'bart_loader_samples_per_sec',
+      'value': round(measured * args.batch_size / dt, 1),
+      'batches': measured,
+      'batch_size': args.batch_size,
+      'avg_batch_ms': round(1000 * dt / measured, 2),
+  }))
+
+
+if __name__ == '__main__':
+  main()
