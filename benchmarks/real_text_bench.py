@@ -1,0 +1,183 @@
+"""Preprocess throughput on REAL English text (no synthetic generator).
+
+Every other preprocessing number in PERF.md is measured on
+:mod:`lddl_tpu.core.synth` output with a vocab trained on the same
+distribution (with `vocab_shift_bench.py` bounding the OOD penalty).
+This bench instead assembles a corpus of real human-written English
+available offline on this box — API documentation prose harvested from
+installed Python packages' docstrings (numpy/jax/scipy/torch/pandas/
+transformers, ~28 MB) plus this repo's own markdown — and pushes it
+through the full BERT preprocess (tokenize -> pair -> mask -> bin ->
+Parquet) with the same committed 30,522-entry vocab the headline bench
+uses.
+
+Real documentation prose is *harder* than Wikipedia for a
+Wikipedia-style vocab: it is denser in identifiers, code fragments, and
+rare technical terms, so its tokens/MB and unk rates bracket the
+realistic worst case from above. Reported next to the synthetic rate
+(``tokens_per_mb`` makes the tokenization workloads comparable).
+
+Prints one JSON line per recipe (dup=1, dup=5); commit the output under
+``benchmarks/results/``. Corpus size: LDDL_REAL_MB (default 32).
+"""
+
+import ast
+import json
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_VOCAB = os.path.join(os.path.dirname(os.path.abspath(__file__)), 'assets',
+                      'bench_vocab_30522.txt')
+_PKGS = ('numpy', 'jax', 'scipy', 'torch', 'pandas', 'transformers')
+
+# Lines that are pure reST/markdown scaffolding, not prose.
+_SCAFFOLD = re.compile(r'^[\s\-=~^#`*.>|+]{3,}$')
+# Markup characters wikiextractor-style cleanup would strip from wiki
+# text; stripping them here keeps the corpus prose-like rather than
+# code-like (snake_case and backticked identifiers are not a workload
+# Wikipedia+Books presents).
+_MARKUP = re.compile(r'[`*_|<>{}\[\]()=#~\\]')
+
+
+def _clean(doc):
+  """Docstring -> one prose paragraph per doc; drops underline/table
+  scaffolding, strips markup chars, collapses whitespace (documents
+  stay one-per-line)."""
+  lines = []
+  for ln in doc.splitlines():
+    ln = ln.strip()
+    if not ln or _SCAFFOLD.match(ln):
+      continue
+    lines.append(ln)
+  return ' '.join(_MARKUP.sub(' ', ' '.join(lines)).split())
+
+
+def _iter_docstrings(pkg_root):
+  for dirpath, dirs, files in os.walk(pkg_root):
+    dirs[:] = [d for d in dirs if d != '__pycache__']
+    for f in sorted(files):
+      if not f.endswith('.py'):
+        continue
+      path = os.path.join(dirpath, f)
+      try:
+        with open(path, encoding='utf-8', errors='ignore') as fh:
+          tree = ast.parse(fh.read())
+      except Exception:
+        continue
+      for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+          d = ast.get_docstring(node)
+          if d and len(d) >= 200:
+            yield d
+
+
+def build_corpus(out_dir, target_mb, num_shards=8):
+  """Harvest real prose into one-document-per-line shards; returns MB."""
+  os.makedirs(out_dir, exist_ok=True)
+  budget = int(target_mb * 1024 * 1024)
+  outs = [open(os.path.join(out_dir, f'real-{i}.txt'), 'w', encoding='utf-8')
+          for i in range(num_shards)]
+  written = 0
+  doc_id = 0
+
+  def emit(text):
+    nonlocal written, doc_id
+    text = _clean(text)
+    if len(text) < 200:
+      return
+    line = f'real-{doc_id} {text}\n'
+    outs[doc_id % num_shards].write(line)
+    written += len(line.encode('utf-8'))
+    doc_id += 1
+
+  repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  for md in sorted(os.listdir(repo_root)):
+    if md.endswith('.md'):
+      with open(os.path.join(repo_root, md), encoding='utf-8') as fh:
+        # Each markdown section (split on blank-line runs) is a document.
+        for chunk in re.split(r'\n\s*\n', fh.read()):
+          emit(chunk)
+  import site
+  site_dirs = site.getsitepackages()
+  for pkg in _PKGS:
+    if written >= budget:
+      break
+    for sd in site_dirs:
+      root = os.path.join(sd, pkg)
+      if not os.path.isdir(root):
+        continue
+      for d in _iter_docstrings(root):
+        emit(d)
+        if written >= budget:
+          break
+      break
+  for f in outs:
+    f.close()
+  return written / (1024 * 1024)
+
+
+def main():
+  target_mb = float(os.environ.get('LDDL_REAL_MB', '32'))
+  work = tempfile.mkdtemp(prefix='lddl_real_')
+  try:
+    src = os.path.join(work, 'source')
+    actual_mb = build_corpus(src, target_mb)
+
+    from lddl_tpu.pipeline.executor import Executor
+    from lddl_tpu.preprocess.bert import BertPretrainConfig, run
+    from lddl_tpu.preprocess.bert import _get_tokenizer
+    from lddl_tpu.preprocess.readers import read_corpus
+
+    import dataclasses
+    cfg = BertPretrainConfig(
+        vocab_file=_VOCAB, target_seq_length=128, bin_size=32,
+        duplicate_factor=5, masking=True, sentence_backend='rules',
+        seed=42, engine='fast', tokenizer_backend='auto',
+        mask_backend='host')
+    executor = Executor()
+    tok = _get_tokenizer(cfg)
+    tok.batch_tokenize(['warm up'])
+    try:
+      import pandas  # noqa: F401  (pyarrow lazily imports it)
+    except ImportError:
+      pass
+
+    # Tokenization workload comparison: tokens and unk share per MB.
+    lines = []
+    for name in sorted(os.listdir(src)):
+      with open(os.path.join(src, name), encoding='utf-8') as f:
+        lines += [ln.split(None, 1)[1] for ln in f if ' ' in ln]
+    ids, _ = tok.encode_batch_ids(lines)
+    tokens_per_mb = len(ids) / actual_mb
+    unk_rate = float((ids == tok.hf.unk_token_id).mean()) if len(ids) else 0.0
+    del ids, lines
+
+    out = {'metric': 'bert_preprocess_real_text_mb_per_sec_per_chip',
+           'unit': 'MB/s/chip', 'corpus_mb': round(actual_mb, 1),
+           'tokens_per_mb': int(tokens_per_mb),
+           'unk_rate': round(unk_rate, 5)}
+    # Warm pass (page cache / allocator steady state), then timed runs.
+    cfg1 = dataclasses.replace(cfg, duplicate_factor=1)
+    corpus = read_corpus([src], num_blocks=4 * executor.num_local_workers)
+    run(corpus, os.path.join(work, 'warm'), cfg1, executor=executor)
+    shutil.rmtree(os.path.join(work, 'warm'), ignore_errors=True)
+    for name, c in (('dup1_mb_per_sec_per_chip', cfg1), ('value', cfg)):
+      corpus = read_corpus([src], num_blocks=4 * executor.num_local_workers)
+      t0 = time.perf_counter()
+      run(corpus, os.path.join(work, 'sink'), c, executor=executor)
+      out[name] = round(actual_mb / (time.perf_counter() - t0), 3)
+      shutil.rmtree(os.path.join(work, 'sink'), ignore_errors=True)
+    print(json.dumps(out))
+  finally:
+    shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == '__main__':
+  main()
