@@ -99,6 +99,21 @@ class FileBackend(CommBackend):
     # of one run must agree on run_id (env LDDL_COMM_RUN_ID, or a job id).
     self._run_id = run_id if run_id is not None else os.environ.get(
         'LDDL_COMM_RUN_ID', 'run0')
+    # Liveness beacon: pid@pidns@starttime, written once. Peers in the
+    # SAME pid namespace use it to fail fast (naming the dead rank) when
+    # a rank is SIGKILLed mid-run instead of stalling until the
+    # collective timeout. The pid-namespace token (readlink of
+    # /proc/self/ns/pid) — not the hostname — gates the probe: two
+    # containers or cloned VMs sharing a rendezvous mount can share a
+    # hostname while their pids are mutually meaningless, which would
+    # make a hostname-gated probe kill healthy runs. The process start
+    # time (field 22 of /proc/<pid>/stat) detects pid reuse. Cross-
+    # namespace peers rely on the timeout, as before.
+    self._pidns = self._pid_namespace()
+    self._starttime = self._pid_starttime(os.getpid())
+    self._write_atomic(
+        f'{os.getpid()}@{self._pidns}@{self._starttime}'.encode(),
+        self._alive_path(self._rank))
 
   @property
   def rank(self):
@@ -113,6 +128,70 @@ class FileBackend(CommBackend):
 
   def _progress_path(self, rank):
     return os.path.join(self._dir, f'{self._run_id}.progress.rank{rank}')
+
+  def _alive_path(self, rank):
+    return os.path.join(self._dir, f'{self._run_id}.alive.rank{rank}')
+
+  @staticmethod
+  def _pid_namespace():
+    """Identity of this process's pid namespace ('' when unavailable —
+    then the beacon never gates a probe and the timeout rules)."""
+    try:
+      return os.readlink('/proc/self/ns/pid')
+    except OSError:
+      return ''
+
+  @staticmethod
+  def _pid_starttime(pid):
+    """Kernel start time of ``pid`` (clock ticks since boot; field 22 of
+    /proc/<pid>/stat), or '' when unreadable. Distinguishes a reused pid
+    from the original process."""
+    try:
+      with open(f'/proc/{pid}/stat', 'rb') as f:
+        data = f.read()
+      return data[data.rfind(b')') + 2:].split()[19].decode()
+    except (OSError, IndexError):
+      return ''
+
+  @classmethod
+  def _pid_dead(cls, pid, starttime):
+    """Positive death signal for a pid in our namespace: process gone,
+    a zombie (SIGKILLed but not yet reaped by its launcher —
+    ``kill(pid, 0)`` still succeeds on zombies, so read the /proc state
+    instead), or a different process now wearing the pid (start-time
+    mismatch). Any probe uncertainty returns False (timeout backstops).
+    """
+    try:
+      with open(f'/proc/{pid}/stat', 'rb') as f:
+        data = f.read()
+    except FileNotFoundError:
+      return True
+    except OSError:
+      return False
+    tail = data[data.rfind(b')') + 2:].split()
+    if tail and tail[0] == b'Z':
+      return True
+    return bool(starttime) and cls._pid_starttime(pid) not in ('', starttime)
+
+  def _check_peer_alive(self, r, seq):
+    """Raise (naming the rank) when a same-pid-namespace peer's process
+    is dead. Only a *positive* death signal raises: a missing or
+    foreign-namespace beacon, or any probe error, keeps the normal
+    timeout path.
+    """
+    try:
+      with open(self._alive_path(r), 'rb') as f:
+        pid_s, pidns, starttime = f.read().decode().split('@', 2)
+      if not self._pidns or pidns != self._pidns or not pid_s.isdigit():
+        return
+      dead = self._pid_dead(int(pid_s), starttime)
+    except Exception:
+      return  # beacon unreadable / not started yet: timeout rules
+    if dead:
+      raise RuntimeError(
+          f'rank {self._rank}: rank {r} (pid {pid_s}) died before '
+          f'collective #{seq}; failing fast instead of waiting out the '
+          f'{self._timeout:.0f}s timeout (dir={self._dir})')
 
   def _write_atomic(self, payload, dst):
     fd, tmp = tempfile.mkstemp(dir=self._dir)
@@ -164,11 +243,16 @@ class FileBackend(CommBackend):
       # one core spent most of its wall-clock here); long waits back off,
       # short waits stay snappy.
       delay = self._poll
+      last_liveness = time.monotonic()
       while not os.path.exists(p):
-        if time.monotonic() > deadline:
+        now = time.monotonic()
+        if now > deadline:
           raise TimeoutError(
               f'rank {self._rank}: timed out waiting for rank {r} at '
               f'collective #{seq} (dir={self._dir})')
+        if now - last_liveness >= 1.0:  # cheap: one stat + kill(pid, 0)
+          self._check_peer_alive(r, seq)
+          last_liveness = now
         time.sleep(delay)
         # Never poll faster than the configured interval: backoff only
         # coarsens waits, it must not override a deliberately slow poll

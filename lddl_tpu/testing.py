@@ -1,0 +1,125 @@
+"""Shared fixtures for the test suite and the driver's multi-chip dry run.
+
+One home for the word-salad corpus/vocab builders and the data-parallel
+drain accounting that both ``tests/test_scale_out.py`` (world-8
+byte-equality) and ``__graft_entry__.dryrun_multichip`` (loader-fed
+8-device train step) enforce — two copies of the loader-sharding
+invariant would drift independently.
+"""
+
+import os
+import random
+
+WORDS = ('alpha', 'bravo', 'charlie', 'delta', 'echo', 'foxtrot', 'golf',
+         'hotel', 'india', 'juliet', 'kilo', 'lima', 'mike', 'november')
+
+
+def write_word_vocab(path, pad_multiple=1):
+  """Minimal WordPiece vocab covering :data:`WORDS`; returns its size.
+
+  ``pad_multiple``: append ``[unusedN]`` entries until the size divides
+  it — vocab-sized params (embedding, MLM bias) must divide evenly over
+  any tensor-parallel mesh axis.
+  """
+  tokens = ['[PAD]', '[UNK]', '[CLS]', '[SEP]', '[MASK]', '.', ',']
+  tokens += list(WORDS) + ['##' + w[1:] for w in WORDS]
+  while len(tokens) % pad_multiple:
+    tokens.append(f'[unused{len(tokens)}]')
+  with open(path, 'w') as f:
+    f.write('\n'.join(tokens) + '\n')
+  return len(tokens)
+
+
+def write_word_corpus(src, num_docs=160, num_shards=1, seed=1234,
+                      sents_range=(2, 6), words_range=(4, 10)):
+  """One-document-per-line corpus of :data:`WORDS` salad under ``src``
+  (created), round-robin across ``num_shards`` files."""
+  os.makedirs(src)
+  r = random.Random(seed)
+  docs = []
+  for d in range(num_docs):
+    sents = [
+        (' '.join(r.choice(WORDS)
+                  for _ in range(r.randrange(*words_range))) + '.').capitalize()
+        for _ in range(r.randrange(*sents_range))
+    ]
+    docs.append(f'doc-{d} ' + ' '.join(sents))
+  for shard in range(num_shards):
+    with open(os.path.join(src, f'{shard}.txt'), 'w') as f:
+      for line in docs[shard::num_shards]:
+        f.write(line + '\n')
+
+
+def drain_rank_keys(balanced_dir, rank, world, bin_size, base_seed,
+                    with_positions=False):
+  """Drain one dp rank's full epoch of raw rows; returns sample keys.
+
+  The exact-drain assert inside the binned iterator fires if violated.
+  """
+  from .comm import NullBackend
+  from .loader import get_bert_pretrain_data_loader
+  loader = get_bert_pretrain_data_loader(
+      balanced_dir,
+      dp_rank=rank,
+      dp_world_size=world,
+      batch_size_per_rank=1,
+      bin_size=bin_size,
+      base_seed=base_seed,
+      comm=NullBackend(),  # .num_samples.json cache: no collectives needed
+      return_raw_samples=True,
+  )
+  keys = []
+  for rows in loader:
+    for row in rows:
+      key = (row['A'], row['B'], bool(row['is_random_next']))
+      if with_positions:
+        key += (bytes(row['masked_lm_positions']),)
+      keys.append(key)
+  return keys
+
+
+def expected_min_truncated_rows(balanced_dir):
+  """Rows a full dp drain must yield: every shard file is truncated to
+  its bin's per-file minimum count (loader/dataset.py), ranks stride
+  files — so per bin, ``min(counts) * num_files``."""
+  from .core import (get_all_bin_ids, get_all_parquets_under,
+                     get_file_paths_for_bin_id)
+  from .pipeline.parquet_io import read_samples
+  paths = get_all_parquets_under(balanced_dir)
+  expected = 0
+  for b in get_all_bin_ids(paths):
+    counts = [len(read_samples(p))
+              for p in get_file_paths_for_bin_id(paths, b)]
+    expected += min(counts) * len(counts)
+  return expected
+
+
+def check_dp_drains(balanced_dir, world, bin_size, base_seed,
+                    drained_keys=None, with_positions=False):
+  """Assert the dp ranks' drains are pairwise disjoint, cover exactly the
+  min-truncated per-bin row count, and consist of real on-disk rows.
+  ``drained_keys``: per-rank key lists (drained here when omitted).
+  Returns the total drained row count.
+  """
+  from .core import get_all_parquets_under
+  from .pipeline.parquet_io import read_samples
+  if drained_keys is None:
+    drained_keys = [
+        drain_rank_keys(balanced_dir, r, world, bin_size, base_seed,
+                        with_positions=with_positions)
+        for r in range(world)
+    ]
+  all_keys = [k for keys in drained_keys for k in keys]
+  assert len(set(all_keys)) == len(all_keys), \
+      'dp ranks drained overlapping rows'
+  expected = expected_min_truncated_rows(balanced_dir)
+  assert len(all_keys) == expected, (len(all_keys), expected)
+  on_disk = set()
+  for p in get_all_parquets_under(balanced_dir):
+    for row in read_samples(p):
+      key = (row['A'], row['B'], bool(row['is_random_next']))
+      if with_positions:
+        key += (bytes(row['masked_lm_positions']),)
+      on_disk.add(key)
+  assert set(all_keys) <= on_disk
+  return len(all_keys)

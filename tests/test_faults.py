@@ -1,0 +1,98 @@
+"""Kill-a-worker fault injection: a hard-killed (SIGKILL) loader worker
+or comm rank must surface a named-rank error on the survivors within
+seconds — the difference between a 2-minute diagnosis and a silent
+multi-hour stall (SURVEY §5 failure detection; the reference gets the
+same property from Dask's worker heartbeats)."""
+
+import multiprocessing
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lddl_tpu.comm import FileBackend
+
+
+class TestLoaderWorkerDeath:
+
+  def test_sigkill_worker_raises_named_error(self, tmp_path):
+    """SIGKILL one of two collate workers mid-epoch; the parent iterator
+    must raise naming the dead worker, not hang."""
+    import __graft_entry__ as g
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+
+    bal, vocab_file, _ = g.build_tiny_dataset(str(tmp_path), num_shards=4)
+    before = {p.pid for p in multiprocessing.active_children()}
+    loader = get_bert_pretrain_data_loader(
+        bal, batch_size_per_rank=2, bin_size=8, max_seq_length=32,
+        vocab_file=vocab_file, masking='static', num_workers=2, base_seed=5)
+    it = iter(loader)
+    next(it)
+    next(it)
+    workers = [p for p in multiprocessing.active_children()
+               if p.pid not in before]
+    assert len(workers) == 2, 'expected exactly the two collate workers'
+    os.kill(workers[0].pid, signal.SIGKILL)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match=r'loader worker \d died'):
+      # keep consuming: the parent drains any already-queued batches from
+      # the dead worker, then must fail fast on its empty queue
+      for _ in it:
+        pass
+    assert time.monotonic() - t0 < 30.0, 'detection took longer than the fail-fast bound'
+
+
+def _fb_rank(rendezvous, rank, world, die_at, q):
+  """One FileBackend rank looping collectives; rank `world-1` SIGKILLs
+  itself before entering collective #die_at."""
+  try:
+    be = FileBackend(rendezvous, rank, world, timeout=60.0, run_id='fault')
+    for i in range(die_at + 10):
+      if rank == world - 1 and i == die_at:
+        os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, no sentinel
+      be.allgather_object(('payload', rank, i))
+    q.put((rank, 'completed', None))
+  except BaseException as e:  # noqa: BLE001 - report everything
+    q.put((rank, 'error', f'{type(e).__name__}: {e}'))
+
+
+class TestCommRankDeath:
+
+  def test_sigkill_rank_fails_fast_on_survivors(self, tmp_path):
+    """SIGKILL one FileBackend rank mid-run: both survivors must raise a
+    RuntimeError naming the dead rank well before the 60s collective
+    timeout (same-host liveness beacon, comm/backend.py)."""
+    world, die_at = 3, 3
+    ctx = multiprocessing.get_context('spawn')
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_fb_rank,
+                    args=(str(tmp_path), r, world, die_at, q), daemon=True)
+        for r in range(world)
+    ]
+    t0 = time.monotonic()
+    for p in procs:
+      p.start()
+    results = {}
+    while len(results) < world - 1 and time.monotonic() - t0 < 55.0:
+      try:
+        rank, kind, detail = q.get(timeout=1.0)
+        results[rank] = (kind, detail)
+      except Exception:
+        pass
+    elapsed = time.monotonic() - t0
+    for p in procs:
+      p.terminate()
+      p.join(timeout=30)
+    assert set(results) == {0, 1}, f'survivors did not report: {results}'
+    for rank, (kind, detail) in results.items():
+      assert kind == 'error', f'rank {rank} should have failed: {kind}'
+      assert f'rank {world - 1}' in detail and 'died' in detail, detail
+      assert 'RuntimeError' in detail, detail
+    assert elapsed < 30.0, (
+        f'survivors took {elapsed:.0f}s — the liveness fast-path should '
+        'beat the 60s timeout by a wide margin')

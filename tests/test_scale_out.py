@@ -27,9 +27,8 @@ import os
 
 from lddl_tpu.balance import balance_directory, load_num_samples_cache
 from lddl_tpu.comm import FileBackend, NullBackend
-from lddl_tpu.core import get_all_bin_ids, get_all_parquets_under
-from lddl_tpu.loader import get_bert_pretrain_data_loader
-from lddl_tpu.pipeline import Executor, read_samples
+from lddl_tpu.core import get_all_parquets_under
+from lddl_tpu.pipeline import Executor
 from lddl_tpu.preprocess import bert
 from lddl_tpu.preprocess.readers import read_corpus
 
@@ -38,41 +37,20 @@ NUM_SHARDS = 8
 NUM_BLOCKS = 16
 SEED = 1234
 
-WORDS = [
-    'alpha', 'bravo', 'charlie', 'delta', 'echo', 'foxtrot', 'golf',
-    'hotel', 'india', 'juliet', 'kilo', 'lima', 'mike', 'november',
-]
+from lddl_tpu.testing import WORDS, write_word_corpus, write_word_vocab
 
 
 def _make_corpus(root):
   """~160 docs with a wide sentence-count spread so all 4 bins fill."""
-  import random
-  r = random.Random(SEED)
   src = os.path.join(root, 'source')
-  os.makedirs(src)
-  docs = []
-  for d in range(160):
-    n_sents = r.randrange(2, 40)
-    sents = []
-    for _ in range(n_sents):
-      n = r.randrange(4, 30)
-      sents.append(
-          (' '.join(r.choice(WORDS) for _ in range(n)) + '.').capitalize())
-    docs.append(f'doc-{d} ' + ' '.join(sents))
-  for shard in range(8):
-    with open(os.path.join(src, f'{shard}.txt'), 'w') as f:
-      for line in docs[shard::8]:
-        f.write(line + '\n')
+  write_word_corpus(src, num_docs=160, num_shards=8, seed=SEED,
+                    sents_range=(2, 40), words_range=(4, 30))
   return src
 
 
 def _make_vocab(root):
   path = os.path.join(root, 'vocab.txt')
-  tokens = ['[PAD]', '[UNK]', '[CLS]', '[SEP]', '[MASK]', '.', ',']
-  tokens += WORDS
-  tokens += ['##' + w[1:] for w in WORDS]
-  with open(path, 'w') as f:
-    f.write('\n'.join(tokens) + '\n')
+  write_word_vocab(path)
   return path
 
 
@@ -100,23 +78,11 @@ def _preprocess_and_balance(src, sink, bal, vocab, comm):
 
 
 def _drain_rank(bal, rank, world):
-  """Drain one dp rank's epoch of raw rows; returns sample keys."""
-  loader = get_bert_pretrain_data_loader(
-      bal,
-      dp_rank=rank,
-      dp_world_size=world,
-      batch_size_per_rank=1,
-      bin_size=32,
-      base_seed=SEED,
-      comm=NullBackend(),  # .num_samples.json cache: no collectives needed
-      return_raw_samples=True,
-  )
-  keys = []
-  for rows in loader:  # exact-drain assert fires inside if violated
-    for row in rows:
-      keys.append((row['A'], row['B'], bool(row['is_random_next']),
-                   bytes(row['masked_lm_positions'])))
-  return keys
+  """Drain one dp rank's epoch of raw rows; returns sample keys (the
+  exact-drain assert fires inside the iterator if violated)."""
+  from lddl_tpu.testing import drain_rank_keys
+  return drain_rank_keys(bal, rank, world, bin_size=32, base_seed=SEED,
+                         with_positions=True)
 
 
 def _worker(rank, rdzv, src, sink, bal, vocab, q):
@@ -208,23 +174,9 @@ def test_world8_pipeline_matches_single_process(tmp_path):
   assert load_num_samples_cache(bal1) == load_num_samples_cache(bal8)
 
   # 3. The 8 dp ranks drained disjoint sample sets with full min-truncated
-  # per-bin coverage.
-  all_keys = [k for _, drained in results.values() for k in drained]
-  assert len(set(all_keys)) == len(all_keys), 'ranks drained overlapping rows'
-
-  paths = get_all_parquets_under(bal8)
-  expected = 0
-  for b in get_all_bin_ids(paths):
-    from lddl_tpu.core import get_file_paths_for_bin_id
-    counts = [len(read_samples(p)) for p in get_file_paths_for_bin_id(paths, b)]
-    assert len(counts) == NUM_SHARDS
-    expected += min(counts) * WORLD  # min-truncation accounting
-  assert len(all_keys) == expected
-
-  # Drained rows are real rows from the balanced shards.
-  on_disk = set()
-  for p in paths:
-    for row in read_samples(p):
-      on_disk.add((row['A'], row['B'], bool(row['is_random_next']),
-                   bytes(row['masked_lm_positions'])))
-  assert set(all_keys) <= on_disk
+  # per-bin coverage, all rows real on-disk rows (shared accounting with
+  # the driver's dryrun: lddl_tpu/testing.py).
+  from lddl_tpu.testing import check_dp_drains
+  check_dp_drains(bal8, WORLD, bin_size=32, base_seed=SEED,
+                  drained_keys=[results[r][1] for r in range(WORLD)],
+                  with_positions=True)
