@@ -75,12 +75,8 @@ def _worker(rank, world, rdzv, src, sink, q):
 
 
 def _hash_dir(d):
-  from lddl_tpu.core.utils import get_all_parquets_under
-  out = {}
-  for p in get_all_parquets_under(d):
-    with open(p, 'rb') as f:
-      out[os.path.basename(p)] = hashlib.sha256(f.read()).hexdigest()
-  return out
+  from lddl_tpu.testing import hash_parquets
+  return hash_parquets(d)
 
 
 def main(argv=None):

@@ -50,6 +50,18 @@ def write_word_corpus(src, num_docs=160, num_shards=1, seed=1234,
         f.write(line + '\n')
 
 
+def hash_parquets(directory):
+  """basename -> sha256 of every Parquet shard under ``directory`` —
+  the byte-equality currency of the scale-out tests."""
+  import hashlib
+  from .core import get_all_parquets_under
+  out = {}
+  for p in get_all_parquets_under(directory):
+    with open(p, 'rb') as f:
+      out[os.path.basename(p)] = hashlib.sha256(f.read()).hexdigest()
+  return out
+
+
 def drain_rank_keys(balanced_dir, rank, world, bin_size, base_seed,
                     with_positions=False):
   """Drain one dp rank's full epoch of raw rows; returns sample keys.

@@ -130,6 +130,128 @@ def test_jax_backend_two_processes():
     assert root_val == 'from-1'
 
 
+def _jax_world8_worker(rank, world, port, root, q):
+  """One rank of the world-8 jax.distributed pipeline-equality run."""
+  try:
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['LDDL_COORDINATOR_ADDRESS'] = f'localhost:{port}'
+    os.environ['LDDL_NUM_PROCESSES'] = str(world)
+    os.environ['LDDL_PROCESS_ID'] = str(rank)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    b = get_backend('jax')
+    assert b.rank == rank and b.world_size == world
+    from lddl_tpu.balance import balance_directory
+    from lddl_tpu.pipeline import Executor
+    from lddl_tpu.preprocess import bert
+    from lddl_tpu.preprocess.readers import read_corpus
+    from lddl_tpu.testing import hash_parquets
+    cfg = bert.BertPretrainConfig(
+        vocab_file=os.path.join(root, 'vocab.txt'), target_seq_length=32,
+        bin_size=8, duplicate_factor=1, masking=True, seed=7,
+        sentence_backend='rules', engine='fast', tokenizer_backend='hf',
+        mask_backend='host')
+    sink = os.path.join(root, 'sink8')
+    bal = os.path.join(root, 'bal8')
+    corpus = read_corpus([os.path.join(root, 'source')], num_blocks=16,
+                         sample_ratio=1.0)
+    bert.run(corpus, sink, cfg, executor=Executor(comm=b,
+                                                  num_local_workers=1),
+             num_shuffle_partitions=16)
+    balance_directory(sink, bal, world, b)
+    b.barrier()
+    # sink/bal are shared paths: one rank hashing covers all of them.
+    payload = (hash_parquets(sink), hash_parquets(bal)) if rank == 0 else None
+    q.put((rank, None, payload))
+  except BaseException as e:  # surface the traceback in the parent
+    import traceback
+    q.put((rank, f'{e!r}\n{traceback.format_exc()}', None))
+    raise
+
+
+def test_jax_backend_world8_pipeline_equality(tmp_path):
+  """The production TPU-pod path (--comm jax) at world size 8: eight
+  jax.distributed-bootstrapped CPU processes run the full preprocess ->
+  balance flow (metadata collectives over the distributed runtime) and
+  must produce byte-identical shards to a single-process NullBackend
+  run — the reduced variant of test_scale_out for the jax backend
+  (reference launches the same flow via mpirun,
+  examples/slurm_example.sub:70-118)."""
+  import socket
+
+  from lddl_tpu.balance import balance_directory
+  from lddl_tpu.pipeline import Executor
+  from lddl_tpu.preprocess import bert
+  from lddl_tpu.preprocess.readers import read_corpus
+  from lddl_tpu.testing import (hash_parquets, write_word_corpus,
+                                write_word_vocab)
+
+  world = 8
+  root = str(tmp_path)
+  write_word_vocab(os.path.join(root, 'vocab.txt'))
+  write_word_corpus(os.path.join(root, 'source'), num_docs=64,
+                    num_shards=4, seed=7, sents_range=(2, 12),
+                    words_range=(4, 16))
+  # Serial reference run in-process.
+  cfg = bert.BertPretrainConfig(
+      vocab_file=os.path.join(root, 'vocab.txt'), target_seq_length=32,
+      bin_size=8, duplicate_factor=1, masking=True, seed=7,
+      sentence_backend='rules', engine='fast',
+      tokenizer_backend='hf', mask_backend='host')
+  corpus = read_corpus([os.path.join(root, 'source')], num_blocks=16,
+                       sample_ratio=1.0)
+  sink1 = os.path.join(root, 'sink1')
+  bal1 = os.path.join(root, 'bal1')
+  bert.run(corpus, sink1, cfg, executor=Executor(num_local_workers=1),
+           num_shuffle_partitions=16)
+  balance_directory(sink1, bal1, world)
+
+  with socket.socket() as s:
+    s.bind(('localhost', 0))
+    port = s.getsockname()[1]
+  ctx = mp.get_context('spawn')
+  q = ctx.Queue()
+  procs = [
+      ctx.Process(target=_jax_world8_worker,
+                  args=(r, world, port, root, q), daemon=True)
+      for r in range(world)
+  ]
+  for p in procs:
+    p.start()
+  results, errors = {}, {}
+  import queue as _queue
+  deadline = 600
+  import time as _time
+  t0 = _time.monotonic()
+  while len(results) + len(errors) < world:
+    try:
+      rank, err, payload = q.get(timeout=5)
+    except _queue.Empty:
+      dead = [r for r, p in enumerate(procs)
+              if p.exitcode not in (None, 0) and r not in results
+              and r not in errors]
+      if dead:  # fail fast naming the rank, not after the full timeout
+        raise RuntimeError(
+            f'ranks {dead} died without reporting '
+            f'(exitcodes {[procs[r].exitcode for r in dead]})')
+      if _time.monotonic() - t0 > deadline:
+        raise TimeoutError(f'ranks never reported: '
+                           f'{sorted(set(range(world)) - set(results))}')
+      continue
+    if err is not None:
+      errors[rank] = err
+    else:
+      results[rank] = payload
+  for p in procs:
+    p.join(timeout=120)
+    assert p.exitcode == 0
+  assert not errors, f'rank failures: {errors}'
+  h_sink8, h_bal8 = results[0]
+  h_sink1, h_bal1 = hash_parquets(sink1), hash_parquets(bal1)
+  assert h_sink1 and h_sink8 == h_sink1, 'preprocess bytes diverged'
+  assert h_bal1 and h_bal8 == h_bal1, 'balance bytes diverged'
+
+
 def test_get_backend_env(tmp_path, monkeypatch):
   monkeypatch.setenv('LDDL_COMM', 'file')
   monkeypatch.setenv('LDDL_COMM_DIR', str(tmp_path))

@@ -21,13 +21,11 @@ partitions are embarrassingly parallel, so world size cannot change the
 bytes on disk.
 """
 
-import hashlib
 import multiprocessing as mp
 import os
 
 from lddl_tpu.balance import balance_directory, load_num_samples_cache
 from lddl_tpu.comm import FileBackend, NullBackend
-from lddl_tpu.core import get_all_parquets_under
 from lddl_tpu.pipeline import Executor
 from lddl_tpu.preprocess import bert
 from lddl_tpu.preprocess.readers import read_corpus
@@ -37,7 +35,7 @@ NUM_SHARDS = 8
 NUM_BLOCKS = 16
 SEED = 1234
 
-from lddl_tpu.testing import WORDS, write_word_corpus, write_word_vocab
+from lddl_tpu.testing import write_word_corpus, write_word_vocab
 
 
 def _make_corpus(root):
@@ -98,11 +96,8 @@ def _worker(rank, rdzv, src, sink, bal, vocab, q):
 
 
 def _hash_dir(d):
-  out = {}
-  for p in get_all_parquets_under(d):
-    with open(p, 'rb') as f:
-      out[os.path.basename(p)] = hashlib.sha256(f.read()).hexdigest()
-  return out
+  from lddl_tpu.testing import hash_parquets
+  return hash_parquets(d)
 
 
 def test_world8_pipeline_matches_single_process(tmp_path):
