@@ -8,11 +8,15 @@ stop compiling past 4k — see attention_bench). Configuration per step:
 ``attention_impl='flash'``, remat on, masked-only MLM head (the b*s*V
 logits chain would otherwise dominate memory at long s).
 
-Batches are synthetic (uniform ids, 15% masked positions) because the
-BERT data pipeline tops out at seq-512 pairs by design; the model,
-sharding, scan-window dispatch amortization, and optimizer are the real
-training stack (`lddl_tpu.parallel.make_scan_train_step`). Writes one
-line per sequence length; OOM is recorded as the datapoint.
+Batches default to synthetic (uniform ids, 15% masked positions); with
+``--packed-data DIR --vocab-file V`` they instead come from the real
+long-context pipeline — :mod:`lddl_tpu.preprocess.packed` shards through
+:func:`lddl_tpu.loader.get_packed_pretrain_data_loader` (token ids,
+dynamic Philox masking) — so the s>=8k steps train on real preprocessed
+data end-to-end. The model, sharding, scan-window dispatch amortization,
+and optimizer are the real training stack
+(`lddl_tpu.parallel.make_scan_train_step`) either way. Writes one line
+per sequence length; OOM is recorded as the datapoint.
 """
 
 import argparse
@@ -43,6 +47,36 @@ def _synthetic_batch(rng, batch, seq_len, vocab, max_predictions):
   }
 
 
+def _drain_packed(args, s):
+  """scan_steps real batches of exactly width s from the packed loader.
+
+  Full-width rows live in the top bin; the loader streams raw rows and
+  only top-bin batches (max num_tokens inside the last bin's range) pay
+  the collate — lower bins are skipped without deserializing ids or
+  drawing masks."""
+  from lddl_tpu.loader import get_packed_pretrain_data_loader
+  from lddl_tpu.loader.packed import PackedCollate
+  from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+  tok = load_bert_tokenizer(vocab_file=args.vocab_file, backend='hf')
+  collate = PackedCollate(tok, base_seed=17)
+  batches = []
+  for epoch in range(8):
+    dl = get_packed_pretrain_data_loader(
+        args.packed_data, vocab_file=args.vocab_file,
+        batch_size_per_rank=args.batch, bin_size=args.bin_size,
+        max_seq_length=s, sequence_length_alignment=128, base_seed=17,
+        start_epoch=epoch, return_raw_samples=True)
+    for step, rows in enumerate(dl):
+      if max(r['num_tokens'] for r in rows) <= s - args.bin_size:
+        continue  # lower bin: batch width would not be s
+      batches.append(collate(rows, s, epoch, step))
+      if len(batches) == args.scan_steps:
+        return batches
+  raise RuntimeError(
+      f'packed dataset yielded only {len(batches)} width-{s} batches; '
+      'regenerate with a matching --target-seq-length')
+
+
 def main(argv=None):
   p = argparse.ArgumentParser(description=__doc__)
   p.add_argument('--seqs', default='8192,16384,32768')
@@ -53,6 +87,13 @@ def main(argv=None):
   p.add_argument('--max-predictions', type=int, default=None,
                  help='default: ceil(0.15 * seq_len)')
   p.add_argument('--out', default=None)
+  p.add_argument('--packed-data', default=None,
+                 help='balanced packed-shard dir (preprocess_packed_'
+                 'pretrain at the matching target length); real rows '
+                 'instead of synthetic')
+  p.add_argument('--vocab-file', default=None)
+  p.add_argument('--bin-size', type=int, default=2048,
+                 help='bin width of the packed shards')
   args = p.parse_args(argv)
 
   import jax
@@ -76,7 +117,16 @@ def main(argv=None):
   print('\n'.join(lines), flush=True)
 
   for s in [int(x) for x in args.seqs.split(',')]:
-    max_pred = args.max_predictions or int(np.ceil(0.15 * s))
+    if args.max_predictions:
+      max_pred = args.max_predictions
+    elif args.packed_data:
+      # dynamic masking has a binomial tail: +4sd headroom, the same
+      # budget check_max_predictions (parallel/train.py) enforces —
+      # an undersized P silently drops overflow MLM targets.
+      sd = (s * 0.15 * 0.85) ** 0.5
+      max_pred = int(s * 0.15 + 4 * sd) + 1
+    else:
+      max_pred = int(np.ceil(0.15 * s))
     cfg = BertConfig(
         vocab_size=vocab, hidden_size=hidden, num_layers=layers,
         num_heads=heads, intermediate_size=inter,
@@ -88,10 +138,13 @@ def main(argv=None):
       opt_state = jax.jit(tx.init, out_shardings=None)(params)
       scan = make_scan_train_step(model, tx, mesh,
                                   max_predictions=max_pred)
-      batches = [
-          _synthetic_batch(rng, args.batch, s, vocab, max_pred)
-          for _ in range(args.scan_steps)
-      ]
+      if args.packed_data:
+        batches = _drain_packed(args, s)
+      else:
+        batches = [
+            _synthetic_batch(rng, args.batch, s, vocab, max_pred)
+            for _ in range(args.scan_steps)
+        ]
       window = stack_batch_window(batches, mesh)
       key = jax.random.key(11)
       params2, opt2, metrics = scan(params, opt_state, key, window)

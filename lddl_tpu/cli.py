@@ -6,6 +6,7 @@ fork's CodeBERT wrapper), all thin delegates:
   preprocess_bert_pretrain       -> lddl_tpu.preprocess.bert
   preprocess_bart_pretrain       -> lddl_tpu.preprocess.bart
   preprocess_codebert_pretrain   -> lddl_tpu.preprocess.codebert
+  preprocess_packed_pretrain     -> lddl_tpu.preprocess.packed (long-context)
   balance_shards                 -> lddl_tpu.balance   (reference name:
                                     balance_dask_output)
   generate_num_samples_cache     -> lddl_tpu.balance
@@ -52,6 +53,11 @@ def preprocess_codebert_pretrain(args=None):
   main(args)
 
 
+def preprocess_packed_pretrain(args=None):
+  from .preprocess.packed import main
+  main(args)
+
+
 def prepare_codesearchnet(args=None):
   from .download.codesearchnet import main
   main(args)
@@ -80,6 +86,7 @@ _COMMANDS = {
     'preprocess_bert_pretrain': preprocess_bert_pretrain,
     'preprocess_bart_pretrain': preprocess_bart_pretrain,
     'preprocess_codebert_pretrain': preprocess_codebert_pretrain,
+    'preprocess_packed_pretrain': preprocess_packed_pretrain,
     'prepare_codesearchnet': prepare_codesearchnet,
     'pretrain_bert': pretrain_bert,
     'balance_shards': balance_shards,

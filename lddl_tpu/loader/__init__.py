@@ -13,12 +13,14 @@ from .bert import get_bert_pretrain_data_loader
 from .binned import BinnedIterator
 from .codebert import get_codebert_pretrain_data_loader
 from .dataset import ParquetShardDataset
+from .packed import get_packed_pretrain_data_loader
 from .shuffle_buffer import ShuffleBuffer
 
 __all__ = [
     'get_bart_pretrain_data_loader',
     'get_bert_pretrain_data_loader',
     'get_codebert_pretrain_data_loader',
+    'get_packed_pretrain_data_loader',
     'BinnedIterator',
     'ParquetShardDataset',
     'ShuffleBuffer',

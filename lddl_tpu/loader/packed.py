@@ -1,0 +1,141 @@
+"""Long-context packed-document loader.
+
+Consumes the shards :mod:`lddl_tpu.preprocess.packed` writes (token ids
+on disk, ``[CLS] doc [SEP] doc [SEP] ...`` rows up to 8k-32k tokens)
+and yields jit-stable batches for long-context training — the data
+path behind the s=32k single-chip and ring-attention capabilities. No
+reference counterpart (the reference tops out at seq-512 pairs).
+
+Batch dict (static per-bin shapes, like the BERT loader):
+
+  input_ids, token_type_ids, attention_mask: int32 [batch, seq_len]
+  labels: int32 [batch, seq_len]  (-100 = not an MLM target; dynamic
+          Philox masking keyed (seed, epoch, rank, step))
+  next_sentence_labels: int32 [batch]  (all zero — packed rows carry no
+          NSP task; present so the BERT train step consumes the batch
+          unchanged)
+
+The collate never re-tokenizes: the np.save-wire id rows deserialize
+straight into the padded batch matrix.
+"""
+
+import numpy as np
+
+from ..core.utils import deserialize_np_array
+from .bert import IGNORE_INDEX, build_pretrain_loader, dynamic_mask_tokens
+
+
+class PackedCollate:
+  """Packed-id rows -> fixed-shape numpy batch dict."""
+
+  def __init__(self, tokenizer, mlm_probability=0.15, base_seed=12345,
+               dp_rank=0):
+    self._mlm_prob = mlm_probability
+    self._base_seed = base_seed
+    self._dp_rank = dp_rank
+    self._cls_id = tokenizer.cls_token_id
+    self._sep_id = tokenizer.sep_token_id
+    self._mask_id = tokenizer.mask_token_id
+    self._pad_id = tokenizer.pad_token_id or 0
+    self._vocab_size = tokenizer.vocab_size
+
+  def __call__(self, rows, seq_len, epoch, step):
+    n = len(rows)
+    ids_arrays = [
+        deserialize_np_array(row['input_ids']).astype(np.int32)
+        for row in rows
+    ]
+    lens = np.fromiter((a.shape[0] for a in ids_arrays), np.int64, count=n)
+    worst = int(lens.max(initial=0))
+    if worst > seq_len:
+      raise AssertionError(
+          f'packed row of {worst} tokens exceeds static seq_len {seq_len}; '
+          'bin assignment or max_seq_length is inconsistent')
+    flat = np.concatenate(ids_arrays) if n else np.zeros(0, np.int32)
+    rowi = np.repeat(np.arange(n), lens)
+    coli = np.arange(flat.shape[0]) - np.repeat(np.cumsum(lens) - lens, lens)
+    input_ids = np.full((n, seq_len), self._pad_id, dtype=np.int32)
+    input_ids[rowi, coli] = flat
+    cols = np.arange(seq_len)
+    attention_mask = (cols < lens[:, None]).astype(np.int32)
+    # Packed rows are a single contiguous stream: segment ids stay 0 (the
+    # stored doc_offsets support block-diagonal consumers; the default
+    # training recipe attends across the packed row).
+    token_type_ids = np.zeros((n, seq_len), dtype=np.int32)
+    special_mask = ((input_ids == self._cls_id) |
+                    (input_ids == self._sep_id) |
+                    (attention_mask == 0))
+    input_ids, labels = dynamic_mask_tokens(
+        input_ids, special_mask, mlm_probability=self._mlm_prob,
+        vocab_size=self._vocab_size, mask_id=self._mask_id,
+        base_seed=self._base_seed, dp_rank=self._dp_rank, epoch=epoch,
+        step=step)
+    return {
+        'input_ids': input_ids,
+        'token_type_ids': token_type_ids,
+        'attention_mask': attention_mask,
+        'labels': labels,
+        'next_sentence_labels': np.zeros(n, dtype=np.int32),
+    }
+
+
+def get_packed_pretrain_data_loader(
+    path,
+    dp_rank=0,
+    dp_world_size=1,
+    batch_size_per_rank=2,
+    vocab_file=None,
+    tokenizer_name=None,
+    lowercase=True,
+    mlm_probability=0.15,
+    max_seq_length=8192,
+    bin_size=None,
+    sequence_length_alignment=128,
+    shuffle_buffer_size=1024,
+    shuffle_buffer_warmup_factor=16,
+    base_seed=12345,
+    start_epoch=0,
+    samples_seen=0,
+    comm=None,
+    tokenizer=None,
+    log_dir=None,
+    log_level=None,
+    return_raw_samples=False,
+    num_workers=0,
+):
+  """Build the long-context packed loader over a (balanced) shard dir.
+
+  Mirrors :func:`~lddl_tpu.loader.bert.get_bert_pretrain_data_loader`
+  (same sharding, binning, resume, and worker-process semantics); only
+  the collate differs. Defaults are long-context-appropriate: small
+  batches, seq alignment 128 (ring/flash block multiples), smaller
+  shuffle buffer (rows are 64-256x BERT-row-sized).
+  """
+  if num_workers:
+    build_kwargs = {k: v for k, v in locals().items() if k != 'num_workers'}
+    from .workers import MultiprocessLoader
+    return MultiprocessLoader(
+        build_kwargs, num_workers,
+        factory=('lddl_tpu.loader.packed', 'get_packed_pretrain_data_loader'))
+  common = dict(
+      dp_rank=dp_rank, dp_world_size=dp_world_size,
+      batch_size_per_rank=batch_size_per_rank,
+      max_seq_length=max_seq_length, bin_size=bin_size,
+      sequence_length_alignment=sequence_length_alignment,
+      shuffle_buffer_size=shuffle_buffer_size,
+      shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+      base_seed=base_seed, start_epoch=start_epoch,
+      samples_seen=samples_seen, comm=comm, log_dir=log_dir,
+      log_level=log_level)
+  if return_raw_samples:
+    return build_pretrain_loader(
+        path, lambda rows, seq_len, epoch, step: rows, **common)
+  if tokenizer is None:
+    from ..tokenization.wordpiece import load_bert_tokenizer
+    tokenizer = load_bert_tokenizer(
+        vocab_file=vocab_file, hub_name=tokenizer_name, lowercase=lowercase,
+        backend='hf')
+  collate = PackedCollate(
+      tokenizer, mlm_probability=mlm_probability, base_seed=base_seed,
+      dp_rank=dp_rank)
+  return build_pretrain_loader(path, collate, **common)

@@ -1,0 +1,291 @@
+"""Long-context packed-document preprocessor.
+
+The NSP pair pipeline tops out at phase-2 lengths (seq 512) by design;
+long-context training (s = 8k-32k, the flagship ring/flash capability)
+needs rows that long. This preprocessor greedily concatenates whole
+tokenized documents into rows of up to ``target_seq_length`` tokens —
+the long-context analogue of the BART sentence aggregator (reference
+``lddl/dask/bart/pretrain.py:88-128``) but token-id based and binned.
+No reference counterpart exists: the reference has no long-context data
+path at all.
+
+Row layout: ``[CLS] doc [SEP] doc [SEP] ...`` — documents longer than
+the row budget are split into budget-sized chunks (standard packing).
+On-disk schema (Parquet, ``part.N.parquet_<bin>`` naming, so the
+balancer and loader shard machinery apply unchanged):
+
+  input_ids:   binary  np.save-wire uint16 — token ids of the whole
+               packed row, specials included (vocabs > 65536 and rows >
+               65535 tokens are rejected loudly; widen the wire format
+               if ever needed)
+  doc_offsets: binary  np.save-wire uint16 — start index of each
+               document's first token within the row (for consumers
+               that want block-diagonal attention; training defaults to
+               full attention over the packed row)
+  num_tokens:  uint16
+
+Ids (not token strings) on disk: at 8k-32k tokens/row, re-tokenizing
+strings at load time would dominate the collate; the loader memory-maps
+the wire format straight into the batch matrix
+(:mod:`lddl_tpu.loader.packed`).
+"""
+
+import argparse
+import dataclasses
+import time
+
+import numpy as np
+import pyarrow as pa
+
+from ..core import attach_bool_arg
+from ..core.utils import u16_batch_binary_parts
+from ..pipeline.executor import Executor
+from ..pipeline.parquet_io import write_table_partition
+from ..pipeline.shuffle import gather_partition
+from .common import run_shuffled
+from .readers import read_corpus, split_id_text
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedPretrainConfig:
+  vocab_file: str = None
+  tokenizer_name: str = None
+  lowercase: bool = True
+  tokenizer_backend: str = 'auto'
+  sentence_backend: str = 'auto'
+  target_seq_length: int = 8192
+  bin_size: int = None
+  seed: int = 12345
+  output_format: str = 'parquet'
+
+  @property
+  def nbins(self):
+    if self.bin_size is None:
+      return None
+    if self.target_seq_length % self.bin_size != 0:
+      raise ValueError('bin_size must divide target_seq_length')
+    return self.target_seq_length // self.bin_size
+
+
+def pack_documents(docs, cls_id, sep_id, target_seq_length):
+  """Greedy packing: (flat row ids, row offsets, flat doc starts, doc
+  start offsets) — all numpy, no per-token Python.
+
+  ``docs``: :class:`~lddl_tpu.preprocess.pairing.TokenizedDocs`. Each
+  row is ``[CLS] d0 [SEP] d1 [SEP] ...``; a document that cannot fit in
+  the remaining budget starts a new row; one longer than a whole row is
+  split into budget-sized chunks. Every row ends with [SEP].
+  """
+  if target_seq_length < 3:
+    # [CLS] + >=1 token + [SEP]; below that `space` never goes positive
+    # and the packing loop cannot make progress.
+    raise ValueError('target_seq_length must be >= 3')
+  soff = docs.sent_offsets
+  dstart = docs.doc_sent_start
+  flat = docs.flat_ids
+  budget = target_seq_length
+  rows = []          # list of np arrays (documents' pieces, with specials)
+  row_lens = []      # running token count per emitted row
+  doc_marks = []     # per row: list of doc start positions
+  cur = [np.array([cls_id], dtype=np.int32)]
+  cur_len = 1
+  cur_marks = []
+
+  def flush():
+    nonlocal cur, cur_len, cur_marks
+    if cur_len > 1:
+      rows.append(np.concatenate(cur))
+      row_lens.append(cur_len)
+      doc_marks.append(cur_marks)
+    cur = [np.array([cls_id], dtype=np.int32)]
+    cur_len = 1
+    cur_marks = []
+
+  sep = np.array([sep_id], dtype=np.int32)
+  for d in range(len(docs)):
+    t0 = int(soff[dstart[d]])
+    t1 = int(soff[dstart[d + 1]])
+    ids = flat[t0:t1]
+    while len(ids):
+      space = budget - cur_len - 1  # room for the trailing [SEP]
+      if space <= 0:
+        flush()
+        continue
+      piece, ids = ids[:space], ids[space:]
+      cur_marks.append(cur_len)
+      cur.append(piece)
+      cur.append(sep)
+      cur_len += len(piece) + 1
+      if cur_len >= budget:
+        flush()
+  flush()
+
+  n = len(rows)
+  row_offsets = np.zeros(n + 1, dtype=np.int64)
+  np.cumsum(np.asarray(row_lens, dtype=np.int64), out=row_offsets[1:])
+  flat_rows = (np.concatenate(rows) if rows else np.zeros(0, np.int32))
+  mark_counts = np.asarray([len(m) for m in doc_marks], dtype=np.int64)
+  mark_offsets = np.zeros(n + 1, dtype=np.int64)
+  np.cumsum(mark_counts, out=mark_offsets[1:])
+  flat_marks = (np.concatenate([np.asarray(m, np.int64) for m in doc_marks])
+                if n else np.zeros(0, np.int64))
+  return flat_rows, row_offsets, flat_marks, mark_offsets
+
+
+def _binary_column(values_u16, offsets):
+  """np.save-wire binary column from flat '<u2' values + offsets."""
+  boffs, bdata = u16_batch_binary_parts(values_u16, offsets)
+  if int(boffs[-1]) > np.iinfo(np.int32).max:
+    raise ValueError('packed column exceeds 2 GiB (Arrow int32 offset '
+                     'limit); use more/smaller partitions')
+  return pa.BinaryArray.from_buffers(
+      pa.binary(), len(offsets) - 1,
+      [None, pa.py_buffer(boffs.astype(np.int32)), pa.py_buffer(bdata)])
+
+
+def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
+  del global_idx
+  from .bert import encode_documents, _get_tokenizer
+  tokenizer = _get_tokenizer(cfg)
+  if tokenizer.vocab_size > np.iinfo(np.uint16).max + 1:
+    raise NotImplementedError(
+        'packed preprocessor stores uint16 ids; vocab exceeds 65536')
+  lines = gather_partition(tgt_idx, spill_dir, cfg.seed)
+  doc_texts = []
+  for line in lines:
+    _, text = split_id_text(line)
+    if text:
+      doc_texts.append(text)
+  docs = encode_documents(doc_texts, tokenizer,
+                          sentence_backend=cfg.sentence_backend)
+  if len(docs) == 0:
+    table = pa.table({
+        'input_ids': pa.array([], type=pa.binary()),
+        'doc_offsets': pa.array([], type=pa.binary()),
+        'num_tokens': pa.array([], type=pa.uint16()),
+    })
+  else:
+    flat_rows, row_offsets, flat_marks, mark_offsets = pack_documents(
+        docs, tokenizer.cls_token_id, tokenizer.sep_token_id,
+        cfg.target_seq_length)
+    num_tokens = np.diff(row_offsets)
+    table = pa.table({
+        'input_ids': _binary_column(flat_rows.astype('<u2'), row_offsets),
+        'doc_offsets': _binary_column(flat_marks.astype('<u2'),
+                                      mark_offsets),
+        'num_tokens': pa.array(num_tokens.astype(np.uint16),
+                               type=pa.uint16()),
+    })
+  out = write_table_partition(
+      table, out_dir, tgt_idx, bin_size=cfg.bin_size, nbins=cfg.nbins,
+      output_format=cfg.output_format)
+  return {b: nrows for b, (_, nrows) in out.items()}
+
+
+def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
+  """Full packed preprocess: global doc shuffle -> tokenize -> greedy
+  pack -> (binned) Parquet. Returns per-partition sample counts."""
+  import functools
+
+  executor = executor or Executor()
+  if cfg.target_seq_length > np.iinfo(np.uint16).max:
+    raise ValueError('target_seq_length > 65535 would overflow the uint16 '
+                     'num_tokens/input_ids wire format')
+  if cfg.target_seq_length < 3:
+    # A row needs [CLS] + >=1 token + [SEP]; below that the packer's
+    # space computation cannot make progress (it would spin).
+    raise ValueError('target_seq_length must be >= 3')
+  if cfg.sentence_backend == 'auto':
+    from ..tokenization.sentences import resolve_backend
+    resolved = executor.comm.broadcast_object(resolve_backend(), root=0)
+    cfg = dataclasses.replace(cfg, sentence_backend=resolved)
+  if cfg.tokenizer_backend == 'auto':
+    from .bert import _get_tokenizer
+    local = None
+    if executor.comm.rank == 0:
+      local = 'native' if _get_tokenizer(cfg).native is not None else 'hf'
+    resolved = executor.comm.broadcast_object(local, root=0)
+    cfg = dataclasses.replace(cfg, tokenizer_backend=resolved)
+  return run_shuffled(
+      corpus,
+      sink_dir,
+      functools.partial(_process_partition, out_dir=sink_dir, cfg=cfg),
+      cfg.seed,
+      executor=executor,
+      num_shuffle_partitions=num_shuffle_partitions)
+
+
+def attach_args(parser):
+  parser.add_argument('--source', type=str, default=None,
+                      help='generic one-doc-per-line source dir')
+  parser.add_argument('--wikipedia', type=str, default=None)
+  parser.add_argument('--books', type=str, default=None)
+  parser.add_argument('--common-crawl', type=str, default=None)
+  parser.add_argument('--open-webtext', type=str, default=None)
+  parser.add_argument('--sink', type=str, required=True)
+  parser.add_argument('--num-blocks', type=int, default=None)
+  parser.add_argument('--sample-ratio', type=float, default=0.9)
+  parser.add_argument('--seed', type=int, default=12345)
+  parser.add_argument('--vocab-file', type=str, default=None)
+  parser.add_argument('--tokenizer', type=str, default=None)
+  parser.add_argument('--tokenizer-backend', type=str, default='auto',
+                      choices=['auto', 'hf', 'native'])
+  parser.add_argument('--sentence-backend', type=str, default='auto',
+                      choices=['auto', 'punkt', 'rules'])
+  parser.add_argument('--target-seq-length', type=int, default=8192)
+  parser.add_argument('--bin-size', type=int, default=None)
+  attach_bool_arg(parser, 'lowercase', default=True)
+  parser.add_argument('--output-format', type=str, default='parquet',
+                      choices=['parquet', 'txt'])
+  parser.add_argument('--num-workers', type=int, default=None)
+  parser.add_argument('--comm', type=str, default='null',
+                      choices=['null', 'file', 'jax'])
+  return parser
+
+
+def main(args=None):
+  parser = attach_args(
+      argparse.ArgumentParser(
+          description=__doc__,
+          formatter_class=argparse.ArgumentDefaultsHelpFormatter))
+  args = parser.parse_args(args)
+  from ..comm import get_backend
+
+  dirs = [
+      d for d in (args.wikipedia, args.books, args.common_crawl,
+                  args.open_webtext, args.source) if d is not None
+  ]
+  if not dirs:
+    parser.error('need at least one source dir')
+  if not args.vocab_file and not args.tokenizer:
+    parser.error('need --vocab-file or --tokenizer')
+  comm = get_backend(args.comm)
+  executor = Executor(comm=comm, num_local_workers=args.num_workers)
+  corpus = read_corpus(
+      dirs,
+      num_blocks=args.num_blocks or 4 * executor.num_local_workers *
+      comm.world_size,
+      sample_ratio=args.sample_ratio,
+      sample_seed=args.seed,
+  )
+  cfg = PackedPretrainConfig(
+      vocab_file=args.vocab_file,
+      tokenizer_name=args.tokenizer,
+      lowercase=args.lowercase,
+      tokenizer_backend=args.tokenizer_backend,
+      sentence_backend=args.sentence_backend,
+      target_seq_length=args.target_seq_length,
+      bin_size=args.bin_size,
+      seed=args.seed,
+      output_format=args.output_format,
+  )
+  t0 = time.perf_counter()
+  counts = run(corpus, args.sink, cfg, executor=executor)
+  if comm.rank == 0:
+    total = sum(n for c in counts for n in c.values())
+    print(f'packed {total} rows into {len(counts)} partitions '
+          f'in {time.perf_counter() - t0:.1f}s')
+
+
+if __name__ == '__main__':
+  main()

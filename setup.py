@@ -33,6 +33,8 @@ setup(
             'preprocess_bart_pretrain=lddl_tpu.cli:preprocess_bart_pretrain',
             'preprocess_codebert_pretrain='
             'lddl_tpu.cli:preprocess_codebert_pretrain',
+            'preprocess_packed_pretrain='
+            'lddl_tpu.cli:preprocess_packed_pretrain',
             'prepare_codesearchnet=lddl_tpu.cli:prepare_codesearchnet',
             'pretrain_bert=lddl_tpu.cli:pretrain_bert',
             'balance_shards=lddl_tpu.cli:balance_shards',
