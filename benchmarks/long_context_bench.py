@@ -56,7 +56,20 @@ def _drain_packed(args, s):
   drawing masks."""
   from lddl_tpu.loader import get_packed_pretrain_data_loader
   from lddl_tpu.loader.packed import PackedCollate
+  from lddl_tpu.pipeline.parquet_io import read_samples
+  from lddl_tpu.core import get_all_parquets_under
   from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+  # One packed dir serves exactly one target length: validate s against
+  # the shards up front instead of crashing mid-drain (too-short s) or
+  # silently replaying 8 full epochs (too-long s).
+  longest = max(
+      (int(r['num_tokens']) for p_ in get_all_parquets_under(args.packed_data)
+       for r in read_samples(p_, columns=['num_tokens'])), default=0)
+  if longest == 0 or not (s - args.bin_size < longest <= s):
+    raise SystemExit(
+        f'--packed-data rows top out at {longest} tokens, which does not '
+        f'fill the top bin of s={s} (expected ({s - args.bin_size}, {s}]); '
+        'regenerate with --target-seq-length matching --seqs')
   tok = load_bert_tokenizer(vocab_file=args.vocab_file, backend='hf')
   collate = PackedCollate(tok, base_seed=17)
   batches = []

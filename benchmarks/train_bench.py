@@ -148,6 +148,8 @@ def build_train_state(args, tokenizer):
   """Model + optimizer + sharded params + jitted step over the mesh."""
   import jax
   import optax
+  if getattr(args, 'prng', 'threefry') != 'threefry':
+    jax.config.update('jax_default_prng_impl', args.prng)
 
   from lddl_tpu.models import BertConfig, BertForPretraining
   from lddl_tpu.parallel import make_mesh, make_train_step, mesh_summary
@@ -163,6 +165,8 @@ def build_train_state(args, tokenizer):
       intermediate_size=inter,
       max_position_embeddings=max(args.max_seq_length, 512),
       attention_impl=args.attention,
+      dropout_rate=args.dropout,
+      ablate=args.ablate,
       remat=args.remat)
   model = BertForPretraining(cfg)
   mesh = make_mesh(data=args.dp, fsdp=args.fsdp, tensor=args.tp,
@@ -533,6 +537,18 @@ def attach_args(parser):
                            'positions per row before the vocab projection '
                            '(honest FLOPs accounting follows); None = '
                            'full-sequence head')
+  parser.add_argument('--prng', default='threefry',
+                      choices=['threefry', 'rbg'],
+                      help="jax PRNG impl; 'rbg' makes per-step dropout "
+                      'draws ~free on TPU (weaker statistical guarantees '
+                      'than threefry, fine for dropout)')
+  parser.add_argument('--ablate', default='',
+                      choices=['', 'attention-core', 'ffn', 'norms', 'gelu'],
+                      help='drop one model component (profiling aid; see '
+                      'BertConfig.ablate)')
+  parser.add_argument('--dropout', type=float, default=0.1,
+                      help='model dropout rate (0 disables the per-step '
+                      'RNG draws entirely)')
   parser.add_argument('--remat', action='store_true',
                       help='rematerialize layer activations (trade FLOPs '
                            'for HBM; lets bigger batches fit)')

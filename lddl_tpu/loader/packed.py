@@ -22,7 +22,7 @@ straight into the padded batch matrix.
 import numpy as np
 
 from ..core.utils import deserialize_np_array
-from .bert import IGNORE_INDEX, build_pretrain_loader, dynamic_mask_tokens
+from .bert import build_pretrain_loader, dynamic_mask_tokens
 
 
 class PackedCollate:

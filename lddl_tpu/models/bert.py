@@ -46,6 +46,11 @@ class BertConfig:
   dtype: Any = jnp.bfloat16
   attention_impl: str = 'dense'  # 'dense' | 'flash' | 'ring' | 'ring_flash'
   remat: bool = False
+  # Profiling aid (benchmarks/train_bench.py --ablate): drop one component
+  # to attribute step time. '' (default) = the real model; 'attention-core'
+  # (ctx := v, q/k gemms DCE'd), 'ffn', 'norms', 'gelu'. Never set in
+  # training configs.
+  ablate: str = ''
 
   @property
   def head_dim(self):
@@ -77,8 +82,10 @@ class SelfAttention(nn.Module):
     q = q.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
-    if (cfg.attention_impl in ('ring', 'ring_flash') and
-        self.mesh is not None):
+    if cfg.ablate == 'attention-core':
+      ctx = v
+    elif (cfg.attention_impl in ('ring', 'ring_flash') and
+          self.mesh is not None):
       from ..parallel.ring import make_ring_attention
       block_impl = 'flash' if cfg.attention_impl == 'ring_flash' else 'dense'
       ctx = make_ring_attention(self.mesh, block_impl=block_impl)(
@@ -115,12 +122,20 @@ class Layer(nn.Module):
     cfg, deterministic = self.cfg, self.deterministic
     attn = SelfAttention(cfg, self.mesh, deterministic, name='attention')(
         x, attention_mask)
-    x = nn.LayerNorm(dtype=cfg.dtype, name='attention_norm')(x + attn)
+    x = x + attn
+    if cfg.ablate != 'norms':
+      x = nn.LayerNorm(dtype=cfg.dtype, name='attention_norm')(x)
+    if cfg.ablate == 'ffn':
+      return x
     h = _dense(cfg.intermediate_size, cfg, 'intermediate')(x)
-    h = nn.gelu(h, approximate=True)
+    if cfg.ablate != 'gelu':
+      h = nn.gelu(h, approximate=True)
     h = _dense(cfg.hidden_size, cfg, 'output')(h)
     h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
-    return nn.LayerNorm(dtype=cfg.dtype, name='output_norm')(x + h)
+    x = x + h
+    if cfg.ablate != 'norms':
+      x = nn.LayerNorm(dtype=cfg.dtype, name='output_norm')(x)
+    return x
 
 
 class Encoder(nn.Module):

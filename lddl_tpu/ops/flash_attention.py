@@ -41,6 +41,7 @@ exercises the identical code path.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -70,9 +71,11 @@ def _padded_len(s):
 # dimension — larger blocks amortize per-grid-step overhead (128-wide kv
 # blocks measured 3-4x slower than 2048-wide at s>=2048) while VMEM use
 # stays modest (2 x block_k x 64 x 2B double-buffered ~= 1 MB at 2048).
-_BLOCK_Q = 128
-_BLOCK_KV_FWD = 4096   # fwd: scores + (m,l,acc) scratch fit comfortably
-_BLOCK_KV_BWD = 2048   # bwd: dk/dv f32 scratch doubles VMEM per block
+# Env overrides (LDDL_FLASH_BLOCK_{Q,KV_FWD,KV_BWD}) support per-shape
+# retuning without code edits — short sequences want smaller kv blocks.
+_BLOCK_Q = int(os.environ.get('LDDL_FLASH_BLOCK_Q', 128))
+_BLOCK_KV_FWD = int(os.environ.get('LDDL_FLASH_BLOCK_KV_FWD', 4096))
+_BLOCK_KV_BWD = int(os.environ.get('LDDL_FLASH_BLOCK_KV_BWD', 2048))
 
 
 def _kv_blocking(s_kv_pad, cap):

@@ -268,6 +268,11 @@ def attach_args(parser):
                       choices=['dense', 'flash', 'ring', 'ring_flash'],
                       default='dense')
   parser.add_argument('--remat', action='store_true')
+  parser.add_argument('--prng', default='threefry',
+                      choices=['threefry', 'rbg'],
+                      help="jax PRNG impl; 'rbg' makes per-step dropout "
+                      'draws ~free on TPU (+2 MFU points measured at '
+                      's=512, benchmarks/results/mfu_v5e_scan_512_r5.txt)')
   parser.add_argument('--dp', type=int, default=1)
   parser.add_argument('--fsdp', type=int, default=1)
   parser.add_argument('--tp', type=int, default=1)
@@ -308,6 +313,9 @@ def main(args=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)).parse_args(
             args)
   import jax
+
+  if getattr(args, 'prng', 'threefry') != 'threefry':
+    jax.config.update('jax_default_prng_impl', args.prng)
 
   from ..comm import get_backend
   from ..models import BertConfig
