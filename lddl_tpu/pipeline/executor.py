@@ -15,15 +15,76 @@ collectives.
 """
 
 import concurrent.futures as _cf
+import json
 import multiprocessing as _mp
 import os
 import sys
+import tempfile
+import time
 
 from ..comm import NullBackend
 
 
 def _run_task(fn, global_index, task):
   return global_index, fn(task, global_index)
+
+
+class ProgressReporter:
+  """Live per-rank progress for long runs — the operational capability
+  the reference gets for free from the Dask distributed dashboard
+  (pinned bokeh, reference ``setup.py:52``): per-worker progress and
+  straggler visibility DURING a multi-hour preprocess, not post-hoc.
+
+  Controlled by env ``LDDL_PROGRESS``:
+    - ``1`` / ``stderr``: one line per phase every >=2 s on stderr
+      (`[lddl <phase>] rank R: done/total (rate/s, eta Ns)`);
+    - a directory path: per-rank JSON heartbeats
+      ``lddl_status.rank<R>.json`` (atomic rename), refreshed every
+      >=2 s — tail/watch them from another terminal, or compare ranks'
+      ``done``/``updated_unix`` to spot stragglers and dead ranks.
+  """
+
+  def __init__(self, spec, rank):
+    self._stderr = spec in ('1', 'true', 'stderr')
+    self._dir = None if self._stderr else spec
+    if self._dir:
+      os.makedirs(self._dir, exist_ok=True)
+    self._rank = rank
+    self._label = None
+    self._t0 = 0.0
+    self._done0 = 0
+    self._last = 0.0
+
+  def update(self, label, done, total, force=False):
+    now = time.monotonic()
+    if label != self._label:
+      # Rate baseline starts at the first completion we observe for the
+      # phase — computing it from `done / ~0s` would print absurd rates.
+      self._label, self._t0, self._done0 = label, now, done
+    if not force and now - self._last < 2.0:
+      return
+    self._last = now
+    elapsed = max(now - self._t0, 1e-9)
+    rate = (done - self._done0) / elapsed if done > self._done0 else None
+    eta = (total - done) / rate if rate else None
+    if self._stderr:
+      rate_s = f'{rate:.1f}/s' if rate else '--/s'
+      eta_s = f'eta {eta:.0f}s' if eta is not None else 'eta --'
+      print(f'[lddl {label}] rank {self._rank}: {done}/{total} '
+            f'({rate_s}, {eta_s})', file=sys.stderr, flush=True)
+      return
+    payload = json.dumps({
+        'rank': self._rank, 'pid': os.getpid(), 'phase': label,
+        'done': done, 'total': total,
+        'tasks_per_sec': round(rate, 3) if rate else None,
+        'eta_sec': round(eta, 1) if eta is not None else None,
+        'updated_unix': time.time(),
+    })
+    fd, tmp = tempfile.mkstemp(dir=self._dir)
+    with os.fdopen(fd, 'w') as f:
+      f.write(payload)
+    os.replace(tmp, os.path.join(self._dir,
+                                 f'lddl_status.rank{self._rank}.json'))
 
 
 def _default_mp_context():
@@ -50,6 +111,9 @@ class Executor:
     # pool off fork.
     self._mp_context = (_mp.get_context(mp_start_method)
                         if mp_start_method else None)
+    spec = os.environ.get('LDDL_PROGRESS')
+    self._progress = (ProgressReporter(spec, self._comm.rank)
+                      if spec else None)
 
   @property
   def comm(self):
@@ -59,28 +123,40 @@ class Executor:
   def num_local_workers(self):
     return self._num_local_workers
 
-  def map(self, fn, tasks, gather=True):
+  def map(self, fn, tasks, gather=True, label='map'):
     """Run ``fn(task, global_index)`` for every task.
 
     Tasks are strided over comm ranks, then over the local process pool.
     With ``gather=True`` every rank returns the full, task-ordered result
     list (results must be picklable metadata, not bulk data); with
     ``gather=False`` each rank returns only ``[(global_index, result), ...]``
-    for its own tasks, followed by a barrier.
+    for its own tasks, followed by a barrier. ``label`` names the phase
+    in live progress reporting (env ``LDDL_PROGRESS``).
     """
     tasks = list(tasks)
     rank = self._comm.rank
     world = self._comm.world_size
     my_indices = list(range(rank, len(tasks), world))
+    total = len(my_indices)
     local_results = []
     if self._num_local_workers <= 1 or len(my_indices) <= 1:
       for i in my_indices:
         local_results.append(_run_task(fn, i, tasks[i]))
+        if self._progress:
+          self._progress.update(label, len(local_results), total,
+                                force=len(local_results) == total)
     else:
       with _cf.ProcessPoolExecutor(
           max_workers=min(self._num_local_workers, len(my_indices)),
           mp_context=self._mp_context or _default_mp_context()) as pool:
         futures = [pool.submit(_run_task, fn, i, tasks[i]) for i in my_indices]
+        if self._progress:
+          # Completion-ordered accounting for the live view; results are
+          # still read back in task order below.
+          done = 0
+          for _ in _cf.as_completed(futures):
+            done += 1
+            self._progress.update(label, done, total, force=done == total)
         for fut in futures:
           local_results.append(fut.result())
     if not gather:

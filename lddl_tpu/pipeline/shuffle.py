@@ -104,7 +104,8 @@ def shuffle_corpus(executor, corpus, spill_dir, seed, num_targets=None):
       sample_ratio=corpus.sample_ratio,
       sample_seed=corpus.sample_seed,
       delimiter=corpus.delimiter)
-  executor.map(task, list(corpus.partitions), gather=False)
+  executor.map(task, list(corpus.partitions), gather=False,
+               label='scatter')
   return num_targets
 
 
@@ -130,5 +131,5 @@ def shuffle_lines(executor, partitions, spill_dir, seed, num_targets=None):
       seed=seed)
   # map(gather=False) ends with a barrier, so all spills are visible to all
   # ranks when this returns.
-  executor.map(task, partitions, gather=False)
+  executor.map(task, partitions, gather=False, label='scatter')
   return num_targets

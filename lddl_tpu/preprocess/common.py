@@ -44,7 +44,7 @@ def run_shuffled(corpus, sink_dir, process_partition, seed, executor=None,
   n = shuffle_corpus(
       executor, corpus, spill_dir, seed, num_targets=num_shuffle_partitions)
   task = functools.partial(process_partition, spill_dir=spill_dir)
-  results = executor.map(task, list(range(n)))
+  results = executor.map(task, list(range(n)), label='process')
   if executor.comm.rank == 0:
     shutil.rmtree(spill_dir, ignore_errors=True)
   return results

@@ -71,6 +71,27 @@ class TestExecutor:
     local = ex.map(_double, [5, 6], gather=False)
     assert sorted(local) == [(0, 10), (1, 12)]
 
+  def test_progress_status_files(self, tmp_path, monkeypatch, capsys):
+    """LDDL_PROGRESS=<dir> writes per-rank JSON heartbeats during map;
+    =stderr prints live lines (the Dask-dashboard-equivalent view)."""
+    import json
+    status = tmp_path / 'status'
+    monkeypatch.setenv('LDDL_PROGRESS', str(status))
+    ex = Executor(num_local_workers=2)
+    assert ex.map(_double, list(range(6)), label='phase-x') == \
+        [2 * i for i in range(6)]
+    payload = json.loads(
+        (status / 'lddl_status.rank0.json').read_text())
+    assert payload['phase'] == 'phase-x'
+    assert payload['done'] == payload['total'] == 6
+    assert payload['tasks_per_sec'] > 0
+
+    monkeypatch.setenv('LDDL_PROGRESS', 'stderr')
+    ex = Executor(num_local_workers=1)
+    ex.map(_double, [1, 2], label='phase-y')
+    err = capsys.readouterr().err
+    assert '[lddl phase-y] rank 0: 2/2' in err
+
 
 def _dist_executor_worker(rank, world, d, src_dir, q):
   comm = FileBackend(d, rank, world, timeout=60.0)
