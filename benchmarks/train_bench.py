@@ -167,6 +167,7 @@ def build_train_state(args, tokenizer):
       attention_impl=args.attention,
       dropout_rate=args.dropout,
       ablate=args.ablate,
+      fused_qkv=args.fused_qkv,
       remat=args.remat)
   model = BertForPretraining(cfg)
   mesh = make_mesh(data=args.dp, fsdp=args.fsdp, tensor=args.tp,
@@ -537,6 +538,9 @@ def attach_args(parser):
                            'positions per row before the vocab projection '
                            '(honest FLOPs accounting follows); None = '
                            'full-sequence head')
+  parser.add_argument('--fused-qkv', action='store_true',
+                      help='single [d,3d] QKV projection (see '
+                      'BertConfig.fused_qkv)')
   parser.add_argument('--prng', default='threefry',
                       choices=['threefry', 'rbg'],
                       help="jax PRNG impl; 'rbg' makes per-step dropout "

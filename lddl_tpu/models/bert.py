@@ -46,6 +46,10 @@ class BertConfig:
   dtype: Any = jnp.bfloat16
   attention_impl: str = 'dense'  # 'dense' | 'flash' | 'ring' | 'ring_flash'
   remat: bool = False
+  # One [d, 3d] projection instead of three [d, d] gemms — fewer, larger
+  # MXU calls (opt-in: changes the param tree, so checkpoints are not
+  # interchangeable with the unfused layout).
+  fused_qkv: bool = False
   # Profiling aid (benchmarks/train_bench.py --ablate): drop one component
   # to attribute step time. '' (default) = the real model; 'attention-core'
   # (ctx := v, q/k gemms DCE'd), 'ffn', 'norms', 'gelu'. Never set in
@@ -76,9 +80,13 @@ class SelfAttention(nn.Module):
     cfg, deterministic = self.cfg, self.deterministic
     b, s, _ = x.shape
     heads, hd = cfg.num_heads, cfg.head_dim
-    q = _dense(cfg.hidden_size, cfg, 'query')(x)
-    k = _dense(cfg.hidden_size, cfg, 'key')(x)
-    v = _dense(cfg.hidden_size, cfg, 'value')(x)
+    if cfg.fused_qkv:
+      qkv = _dense(3 * cfg.hidden_size, cfg, 'qkv')(x)
+      q, k, v = jnp.split(qkv, 3, axis=-1)
+    else:
+      q = _dense(cfg.hidden_size, cfg, 'query')(x)
+      k = _dense(cfg.hidden_size, cfg, 'key')(x)
+      v = _dense(cfg.hidden_size, cfg, 'value')(x)
     q = q.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
     k = k.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
     v = v.reshape(b, s, heads, hd).transpose(0, 2, 1, 3)
@@ -226,6 +234,8 @@ _RULES = (
     ('word_embeddings/embedding', ('tensor', 'fsdp')),
     ('position_embeddings/embedding', (None, None)),
     ('token_type_embeddings/embedding', (None, None)),
+    ('qkv/kernel', ('fsdp', 'tensor')),
+    ('qkv/bias', ('tensor',)),
     ('query/kernel', ('fsdp', 'tensor')),
     ('key/kernel', ('fsdp', 'tensor')),
     ('value/kernel', ('fsdp', 'tensor')),
