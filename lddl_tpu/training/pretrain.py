@@ -77,11 +77,12 @@ class TrainLoop:
             warmup_steps=100, total_steps=10000, weight_decay=0.01,
             batch_size_per_rank=64, bin_size=None, max_seq_length=512,
             masking='dynamic', seed=127, samples_seen=0, loader_kwargs=None,
-            max_predictions=None):
+            max_predictions=None, data_format='pairs'):
     import jax
     import optax
 
-    from ..loader import get_bert_pretrain_data_loader
+    from ..loader import (get_bert_pretrain_data_loader,
+                          get_packed_pretrain_data_loader)
     from ..models import BertForPretraining
     from ..parallel import make_train_step
     from ..parallel.train import init_params
@@ -91,18 +92,36 @@ class TrainLoop:
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
     tx = optax.adamw(schedule, weight_decay=weight_decay)
     dp_rank, dp_world = jax.process_index(), jax.process_count()
-    loader = get_bert_pretrain_data_loader(
-        path,
-        dp_rank=dp_rank,
-        dp_world_size=dp_world,
-        batch_size_per_rank=batch_size_per_rank,
-        tokenizer=tokenizer,
-        masking=masking,
-        max_seq_length=max_seq_length,
-        bin_size=bin_size,
-        base_seed=seed,
-        samples_seen=samples_seen,
-        **(loader_kwargs or {}))
+    if data_format == 'packed':
+      # Long-context document-packed shards (preprocess_packed_pretrain):
+      # always dynamic masking, no NSP pairs.
+      if masking != 'dynamic':
+        raise ValueError("data_format='packed' supports masking='dynamic' "
+                         'only (no stored masks in packed shards)')
+      loader = get_packed_pretrain_data_loader(
+          path,
+          dp_rank=dp_rank,
+          dp_world_size=dp_world,
+          batch_size_per_rank=batch_size_per_rank,
+          tokenizer=tokenizer,
+          max_seq_length=max_seq_length,
+          bin_size=bin_size,
+          base_seed=seed,
+          samples_seen=samples_seen,
+          **(loader_kwargs or {}))
+    else:
+      loader = get_bert_pretrain_data_loader(
+          path,
+          dp_rank=dp_rank,
+          dp_world_size=dp_world,
+          batch_size_per_rank=batch_size_per_rank,
+          tokenizer=tokenizer,
+          masking=masking,
+          max_seq_length=max_seq_length,
+          bin_size=bin_size,
+          base_seed=seed,
+          samples_seen=samples_seen,
+          **(loader_kwargs or {}))
     params = init_params(model, mesh, jax.random.key(seed),
                          seq_len=min(128, max_seq_length))
     opt_state = _place_opt_state(jax.jit(tx.init)(params), params, mesh)
@@ -283,6 +302,11 @@ def attach_args(parser):
   parser.add_argument('--max-seq-length', type=int, default=512)
   parser.add_argument('--masking', choices=['dynamic', 'static'],
                       default='dynamic')
+  parser.add_argument('--data-format', choices=['pairs', 'packed'],
+                      default='pairs',
+                      help="'pairs': NSP-pair shards (preprocess_bert_"
+                      "pretrain); 'packed': long-context document-packed "
+                      'id shards (preprocess_packed_pretrain, s=8k-32k)')
   parser.add_argument('--steps', type=int, default=1000)
   parser.add_argument('--learning-rate', type=float, default=1e-4)
   parser.add_argument('--warmup-steps', type=int, default=100)
@@ -353,7 +377,8 @@ def main(args=None):
       batch_size_per_rank=args.batch_size, bin_size=args.bin_size,
       max_seq_length=args.max_seq_length, masking=args.masking,
       seed=args.seed, samples_seen=samples_seen,
-      max_predictions=args.max_predictions)
+      max_predictions=args.max_predictions,
+      data_format=args.data_format)
   if resume:
     loop.restore(args.checkpoint_dir)
   losses = loop.run(args.steps, ckpt_dir=args.checkpoint_dir,

@@ -165,6 +165,23 @@ class TestPackedPipeline:
           keys.append(bytes(row['input_ids']))
     assert len(set(keys)) == len(keys), 'dp ranks drained overlapping rows'
 
+  def test_pretrain_cli_on_packed_shards(self, tmp_path, capsys):
+    """pretrain_bert --data-format packed: the full production trainer
+    (mesh, warmup-cosine adamw, checkpointing machinery) runs on
+    long-context packed shards end-to-end."""
+    root = str(tmp_path)
+    _, _, bal, vocab = _build(root)
+    from lddl_tpu.training.pretrain import main
+    loop = main([
+        '--path', bal, '--vocab-file', vocab, '--model', 'tiny',
+        '--data-format', 'packed', '--bin-size', '128',
+        '--max-seq-length', '512', '--batch-size', '8', '--steps', '2',
+        '--warmup-steps', '1', '--log-every', '1',
+    ])
+    out = capsys.readouterr().out
+    assert loop.step == 2
+    assert 'final_loss' in out
+
   def test_train_step_consumes_packed_batch(self, tmp_path):
     """One real train step (tiny model, 1024-token packed rows, CPU) on
     loader output — the path the s>=8k chip runs take
