@@ -47,20 +47,24 @@ def main():
   n = 0
   t0 = None
   epoch = 0
-  while n < args.iters:
+  target = args.iters + args.warmup
+  while n < target:
     for batch in make_loader(epoch):
       assert batch['input_ids'].shape[0] == args.batch_size
       assert batch['labels'].shape == batch['input_ids'].shape
       n += 1
       if n == args.warmup:
         t0 = time.perf_counter()
-      if n >= args.iters + args.warmup:
+      if n >= target:
         break
     epoch += 1
-    if epoch > 100:
+    if epoch > 100 or t0 is None and n >= target:
       raise RuntimeError('dataset too small for the requested --iters')
-    if n >= args.iters + args.warmup:
+    if n >= target:
       break
+  if t0 is None:
+    raise RuntimeError(
+        f'--warmup {args.warmup} never reached ({n} batches drained)')
   dt = time.perf_counter() - t0
   measured = n - args.warmup
   print(json.dumps({

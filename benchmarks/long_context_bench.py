@@ -66,7 +66,7 @@ def _drain_packed(args, s):
       (int(r['num_tokens']) for p_ in get_all_parquets_under(args.packed_data)
        for r in read_samples(p_, columns=['num_tokens'])), default=0)
   if longest == 0 or not (s - args.bin_size < longest <= s):
-    raise SystemExit(
+    raise RuntimeError(
         f'--packed-data rows top out at {longest} tokens, which does not '
         f'fill the top bin of s={s} (expected ({s - args.bin_size}, {s}]); '
         'regenerate with --target-seq-length matching --seqs')

@@ -111,9 +111,10 @@ class Executor:
     # pool off fork.
     self._mp_context = (_mp.get_context(mp_start_method)
                         if mp_start_method else None)
-    spec = os.environ.get('LDDL_PROGRESS')
+    spec = os.environ.get('LDDL_PROGRESS', '')
+    # '0'/'false'/'off' must disable, not become a directory named '0'.
     self._progress = (ProgressReporter(spec, self._comm.rank)
-                      if spec else None)
+                      if spec not in ('', '0', 'false', 'off') else None)
 
   @property
   def comm(self):

@@ -191,10 +191,6 @@ def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
   if cfg.target_seq_length > np.iinfo(np.uint16).max:
     raise ValueError('target_seq_length > 65535 would overflow the uint16 '
                      'num_tokens/input_ids wire format')
-  if cfg.target_seq_length < 3:
-    # A row needs [CLS] + >=1 token + [SEP]; below that the packer's
-    # space computation cannot make progress (it would spin).
-    raise ValueError('target_seq_length must be >= 3')
   if cfg.sentence_backend == 'auto':
     from ..tokenization.sentences import resolve_backend
     resolved = executor.comm.broadcast_object(resolve_backend(), root=0)

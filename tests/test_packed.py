@@ -24,7 +24,7 @@ SEED = 31
 
 def _build(root, target=512, bin_size=128, num_shards=2):
   vocab = os.path.join(root, 'vocab.txt')
-  write_word_vocab(vocab, pad_multiple=8)
+  vocab_size = write_word_vocab(vocab, pad_multiple=8)
   src = os.path.join(root, 'source')
   write_word_corpus(src, num_docs=120, seed=SEED, sents_range=(2, 20),
                     words_range=(4, 24))
@@ -36,7 +36,7 @@ def _build(root, target=512, bin_size=128, num_shards=2):
   corpus = read_corpus([src], num_blocks=4, sample_ratio=1.0)
   packed.run(corpus, sink, cfg, executor=Executor(num_local_workers=1))
   balance_directory(sink, bal, num_shards)
-  return src, sink, bal, vocab
+  return src, sink, bal, vocab, vocab_size
 
 
 class TestPackDocuments:
@@ -89,7 +89,7 @@ class TestPackedPipeline:
 
   def test_preprocess_balance_load(self, tmp_path):
     root = str(tmp_path)
-    _, sink, bal, vocab = _build(root)
+    _, sink, bal, vocab, _ = _build(root)
     # shards carry the wire columns
     from lddl_tpu.core import get_all_parquets_under
     rows = []
@@ -122,7 +122,7 @@ class TestPackedPipeline:
 
   def test_deterministic_across_runs(self, tmp_path):
     root = str(tmp_path)
-    _, _, bal, vocab = _build(root)
+    _, _, bal, vocab, _ = _build(root)
     def drain():
       dl = get_packed_pretrain_data_loader(
           bal, vocab_file=vocab, batch_size_per_rank=2, bin_size=128,
@@ -139,7 +139,7 @@ class TestPackedPipeline:
     (the documented MultiprocessLoader contract, via the packed
     factory)."""
     root = str(tmp_path)
-    _, _, bal, vocab = _build(root)
+    _, _, bal, vocab, _ = _build(root)
     def drain(workers):
       dl = get_packed_pretrain_data_loader(
           bal, vocab_file=vocab, batch_size_per_rank=2, bin_size=128,
@@ -153,7 +153,7 @@ class TestPackedPipeline:
 
   def test_dp_ranks_drain_disjoint(self, tmp_path):
     root = str(tmp_path)
-    _, _, bal, vocab = _build(root)
+    _, _, bal, vocab, _ = _build(root)
     keys = []
     for rank in range(2):
       dl = get_packed_pretrain_data_loader(
@@ -170,7 +170,7 @@ class TestPackedPipeline:
     (mesh, warmup-cosine adamw, checkpointing machinery) runs on
     long-context packed shards end-to-end."""
     root = str(tmp_path)
-    _, _, bal, vocab = _build(root)
+    _, _, bal, vocab, _ = _build(root)
     from lddl_tpu.training.pretrain import main
     loop = main([
         '--path', bal, '--vocab-file', vocab, '--model', 'tiny',
@@ -196,10 +196,8 @@ class TestPackedPipeline:
                                          shard_batch)
 
     root = str(tmp_path)
-    _, _, bal, vocab = _build(root, target=1024, bin_size=256,
-                              num_shards=2)
-    from lddl_tpu.testing import write_word_vocab as _wv
-    vocab_size = _wv(os.path.join(root, 'v2.txt'), pad_multiple=8)
+    _, _, bal, vocab, vocab_size = _build(root, target=1024, bin_size=256,
+                                          num_shards=2)
     dl = get_packed_pretrain_data_loader(
         bal, vocab_file=vocab, batch_size_per_rank=2, bin_size=256,
         max_seq_length=1024, base_seed=SEED)
