@@ -213,7 +213,15 @@ class TrainLoop:
 
     self.params = _like(restored['state']['params'], self.params)
     self.opt_state = _like(restored['state']['opt_state'], self.opt_state)
-    self.rng = jax.random.wrap_key_data(restored['state']['rng'])
+    # Replicate the restored key over the mesh: orbax hands back an array
+    # committed to one device, and a committed single-device key conflicts
+    # with mesh-sharded params inside the jitted step (a fresh
+    # jax.random.key is uncommitted, so the bug only bites after restore
+    # on multi-device meshes).
+    from jax.sharding import NamedSharding, PartitionSpec
+    self.rng = jax.device_put(
+        jax.random.wrap_key_data(restored['state']['rng']),
+        NamedSharding(self.mesh, PartitionSpec()))
     self.step = restored['meta']['step']
     self.samples_seen = restored['meta']['samples_seen']
     self._last_saved = self.step  # this step already exists on disk

@@ -182,6 +182,33 @@ class TestPackedPipeline:
     assert loop.step == 2
     assert 'final_loss' in out
 
+  def test_pretrain_packed_resume_matches_uninterrupted(self, tmp_path,
+                                                        capsys):
+    """Checkpoint at step 2 of 4, restart with --resume: the restored
+    run must land on the same final step/samples_seen as the
+    uninterrupted one (the samples_seen replay contract, now over
+    packed shards)."""
+    root = str(tmp_path)
+    _, _, bal, vocab, _ = _build(root)
+    from lddl_tpu.training.pretrain import main
+    base = [
+        '--path', bal, '--vocab-file', vocab, '--model', 'tiny',
+        '--data-format', 'packed', '--bin-size', '128',
+        '--max-seq-length', '512', '--batch-size', '8',
+        '--warmup-steps', '1', '--log-every', '10',
+    ]
+    full = main(base + ['--steps', '4'])
+    interrupted = main(base + [
+        '--steps', '2', '--checkpoint-dir', os.path.join(root, 'ckpt'),
+        '--checkpoint-every', '2'])
+    assert interrupted.step == 2
+    resumed = main(base + [
+        '--steps', '4', '--checkpoint-dir', os.path.join(root, 'ckpt'),
+        '--resume'])
+    capsys.readouterr()
+    assert resumed.step == full.step == 4
+    assert resumed.samples_seen == full.samples_seen
+
   def test_train_step_consumes_packed_batch(self, tmp_path):
     """One real train step (tiny model, 1024-token packed rows, CPU) on
     loader output — the path the s>=8k chip runs take
