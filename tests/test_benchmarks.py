@@ -113,6 +113,51 @@ def test_train_mode_tiny_model(shards, tiny_vocab, tmp_path):
   assert summary['devices'] == 8  # conftest virtual CPU mesh
 
 
+def test_bart_loader_bench_smoke(tiny_vocab, tmp_path, capsys):
+  """The committed BART-loader artifact must stay reproducible: the
+  bench drains balanced sentences shards and prints one JSON line."""
+  bench = _load('bart_loader_bench')
+  root = tmp_path / 'bart'
+  root.mkdir()
+  r = random.Random(3)
+  words = ['alpha', 'bravo', 'charlie', 'delta', 'echo']
+  for shard in range(2):
+    sents = [' '.join(r.choice(words) for _ in range(12)) + '.'
+             for _ in range(24)]
+    pq.write_table(pa.table({'sentences': sents}),
+                   root / f'shard-{shard}.parquet')
+  import sys
+  argv = sys.argv
+  try:
+    sys.argv = ['x', '--path', str(root), '--vocab-file', tiny_vocab,
+                '--batch-size', '4', '--iters', '4', '--warmup', '1']
+    bench.main()
+  finally:
+    sys.argv = argv
+  out = capsys.readouterr().out.strip().splitlines()[-1]
+  payload = json.loads(out)
+  assert payload['metric'] == 'bart_loader_samples_per_sec'
+  assert payload['batches'] == 4 and payload['value'] > 0
+
+
+def test_real_text_corpus_harvest(tmp_path):
+  """real_text_bench's harvester yields real prose documents in the
+  one-doc-per-line source format with markup stripped."""
+  bench = _load('real_text_bench')
+  mb = bench.build_corpus(str(tmp_path / 'src'), 0.2, num_shards=2)
+  assert mb >= 0.1
+  lines = []
+  for name in os.listdir(tmp_path / 'src'):
+    with open(tmp_path / 'src' / name, encoding='utf-8') as f:
+      lines += f.readlines()
+  assert len(lines) > 10
+  for ln in lines[:50]:
+    doc_id, text = ln.split(None, 1)
+    assert doc_id.startswith('real-')
+    assert len(text) >= 200
+    assert '`' not in text and '_' not in text  # markup stripped
+
+
 def test_flops_accounting_scales():
   from lddl_tpu.models import BertConfig
   from lddl_tpu.models.flops import bert_pretrain_flops_per_step
