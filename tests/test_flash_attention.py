@@ -178,6 +178,25 @@ def test_make_flash_attention_rejects_seq_mesh():
 
 
 @pytest.mark.parametrize('caps', [(128, 128), (256, 256)])
+def test_block_env_overrides():
+  """LDDL_FLASH_BLOCK_* env vars must be honored at import (the
+  per-shape retuning knob benchmarks rely on; results stay equal across
+  blockings — test_multiblock_kv_grid)."""
+  import os
+  import subprocess
+  import sys
+  env = dict(os.environ, LDDL_FLASH_BLOCK_Q='256',
+             LDDL_FLASH_BLOCK_KV_FWD='512', LDDL_FLASH_BLOCK_KV_BWD='512',
+             JAX_PLATFORMS='cpu')
+  out = subprocess.run(
+      [sys.executable, '-c',
+       'from lddl_tpu.ops import flash_attention as fa;'
+       'print(fa._BLOCK_Q, fa._BLOCK_KV_FWD, fa._BLOCK_KV_BWD)'],
+      env=env, capture_output=True, text=True, check=True,
+      cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+  assert out.stdout.split() == ['256', '512', '512']
+
+
 def test_multiblock_kv_grid(monkeypatch, caps):
   """Force the innermost kv grid dimension to take multiple steps (the
   default caps of 4096/2048 make every CPU-sized test a single step, so
