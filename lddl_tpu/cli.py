@@ -10,6 +10,9 @@ fork's CodeBERT wrapper), all thin delegates:
   balance_shards                 -> lddl_tpu.balance   (reference name:
                                     balance_dask_output)
   generate_num_samples_cache     -> lddl_tpu.balance
+  telemetry_report               -> lddl_tpu.telemetry.report (merge
+                                    per-rank telemetry JSONL into a
+                                    per-stage bottleneck summary)
 
 Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
 installed console scripts.
@@ -78,6 +81,11 @@ def generate_num_samples_cache(args=None):
   cache_main(args)
 
 
+def telemetry_report(args=None):
+  from .telemetry.report import main
+  return main(args)
+
+
 _COMMANDS = {
     'download_wikipedia': download_wikipedia,
     'download_books': download_books,
@@ -92,6 +100,8 @@ _COMMANDS = {
     'balance_shards': balance_shards,
     'balance_dask_output': balance_shards,  # reference-compatible alias
     'generate_num_samples_cache': generate_num_samples_cache,
+    'telemetry_report': telemetry_report,
+    'telemetry-report': telemetry_report,  # dash-form alias
 }
 
 

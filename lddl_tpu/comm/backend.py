@@ -25,6 +25,8 @@ import time
 
 import numpy as np
 
+from ..telemetry import get_telemetry
+
 
 class CommBackend:
   """Protocol: rank/world_size + tiny-metadata collectives."""
@@ -188,6 +190,13 @@ class FileBackend(CommBackend):
     except Exception:
       return  # beacon unreadable / not started yet: timeout rules
     if dead:
+      # Death is only an error if the peer died *without* publishing
+      # this collective. A peer whose last act was writing its payload
+      # for #seq and exiting cleanly (e.g. last rank of a finishing job)
+      # races this probe: its file may have appeared between our stat
+      # poll and this liveness check, so re-check before raising.
+      if os.path.exists(self._path(seq, r)):
+        return
       raise RuntimeError(
           f'rank {self._rank}: rank {r} (pid {pid_s}) died before '
           f'collective #{seq}; failing fast instead of waiting out the '
@@ -226,6 +235,8 @@ class FileBackend(CommBackend):
     self._gc_upto = max(self._gc_upto, min_seq)
 
   def allgather_object(self, obj):
+    tele = get_telemetry()
+    t_start = time.monotonic() if tele.enabled else 0.0
     seq = self._seq
     self._seq += 1
     # Publish progress (highest collective this rank has *entered* — all
@@ -260,6 +271,12 @@ class FileBackend(CommBackend):
         delay = min(delay * 2, max(self._poll, 0.05))
       with open(p, 'rb') as f:
         results.append(pickle.loads(f.read()))
+    if tele.enabled:
+      # Collective latency includes peer wait, so cross-rank spread here
+      # is the straggler signal the report surfaces per rank.
+      tele.histogram('comm.allgather_seconds').observe(
+          time.monotonic() - t_start)
+      tele.counter('comm.allgathers').add(1)
     return results
 
 
@@ -333,6 +350,8 @@ class JaxProcessBackend(CommBackend):
 
   def allgather_object(self, obj):
     from jax.experimental import multihost_utils
+    tele = get_telemetry()
+    t_start = time.monotonic() if tele.enabled else 0.0
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     # Pad to the max payload size across ranks so shapes are uniform.
     sizes = multihost_utils.process_allgather(
@@ -342,10 +361,15 @@ class JaxProcessBackend(CommBackend):
     padded[:payload.size] = payload
     gathered = multihost_utils.process_allgather(padded)
     flat_sizes = np.asarray(sizes).reshape(-1)
-    return [
+    out = [
         pickle.loads(gathered[r, :int(flat_sizes[r])].tobytes())
         for r in range(self.world_size)
     ]
+    if tele.enabled:
+      tele.histogram('comm.allgather_seconds').observe(
+          time.monotonic() - t_start)
+      tele.counter('comm.allgathers').add(1)
+    return out
 
   def allreduce_sum(self, array):
     from jax.experimental import multihost_utils
@@ -355,7 +379,8 @@ class JaxProcessBackend(CommBackend):
 
   def barrier(self):
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices('lddl_tpu_barrier')
+    with get_telemetry().histogram('comm.barrier_seconds').time():
+      multihost_utils.sync_global_devices('lddl_tpu_barrier')
 
 
 def get_backend(name=None, **kwargs):

@@ -26,11 +26,14 @@ TPU-first deltas vs the reference collate (``torch/bert.py:69-196``):
     by (seed, epoch, rank, step) so resumes reproduce identical masks.
 """
 
+import time
+
 import numpy as np
 
 from ..comm import get_backend
 from ..core.utils import (get_all_bin_ids, get_all_parquets_under,
                           get_file_paths_for_bin_id)
+from ..telemetry import get_telemetry
 from .binned import BinnedIterator
 from .dataset import ParquetShardDataset
 
@@ -97,6 +100,8 @@ class BertCollate:
     """Fully vectorized: no per-row Python inner loop. One id-conversion
     call per batch, then ragged scatter via ``np.repeat``/cumsum index
     arithmetic builds every array in whole-batch numpy ops."""
+    tele = get_telemetry()
+    t0 = time.monotonic() if tele.enabled else 0.0
     n = len(rows)
     arange_n = np.arange(n)
     cols = np.arange(seq_len)
@@ -179,6 +184,13 @@ class BertCollate:
       special_mask[row_b, col_b] = False
       input_ids, labels = self._mask_tokens(input_ids, special_mask, epoch,
                                             step)
+    if tele.enabled:
+      # Per-bin latency: each static seq_len is its own compiled shape
+      # downstream, so its collate cost is tracked under its own name.
+      tele.histogram(f'loader.collate_seconds.s{seq_len}').observe(
+          time.monotonic() - t0)
+      tele.counter('loader.batches').add(1)
+      tele.counter('loader.collated_rows').add(n)
     return {
         'input_ids': input_ids,
         'token_type_ids': token_type_ids,

@@ -32,6 +32,7 @@ import pyarrow.parquet as pq
 from ..balance import load_num_samples_cache
 from ..core.random import rng_from_key
 from ..core.utils import count_parquet_samples_strided
+from ..telemetry import get_telemetry
 from .shuffle_buffer import ShuffleBuffer
 
 
@@ -144,6 +145,12 @@ class ParquetShardDataset:
     return buf.shuffle_stream(self._row_stream(files, skip_files, skip_rows))
 
   def _row_stream(self, files, skip_files, skip_rows):
+    # Telemetry handles are fetched once per stream (not per event): in
+    # disabled mode they are the shared no-op singletons, so the per-row
+    # cost is one empty method call.
+    tele = get_telemetry()
+    rows_c = tele.counter('loader.rows')
+    decode_h = tele.histogram('loader.read_batch_seconds')
     for fi, path in enumerate(files):
       if fi < skip_files:
         continue
@@ -158,9 +165,11 @@ class ParquetShardDataset:
         if to_skip >= take:
           to_skip -= take
           continue
-        cols = {name: batch.column(i).to_pylist()
-                for i, name in enumerate(batch.schema.names)}
+        with decode_h.time():
+          cols = {name: batch.column(i).to_pylist()
+                  for i, name in enumerate(batch.schema.names)}
         n = take
         for r in range(to_skip, n):
+          rows_c.add(1)
           yield {name: col[r] for name, col in cols.items()}
         to_skip = 0

@@ -19,9 +19,12 @@ The collate never re-tokenizes: the np.save-wire id rows deserialize
 straight into the padded batch matrix.
 """
 
+import time
+
 import numpy as np
 
 from ..core.utils import deserialize_np_array
+from ..telemetry import get_telemetry
 from .bert import build_pretrain_loader, dynamic_mask_tokens
 
 
@@ -40,6 +43,8 @@ class PackedCollate:
     self._vocab_size = tokenizer.vocab_size
 
   def __call__(self, rows, seq_len, epoch, step):
+    tele = get_telemetry()
+    t0 = time.monotonic() if tele.enabled else 0.0
     n = len(rows)
     ids_arrays = [
         deserialize_np_array(row['input_ids']).astype(np.int32)
@@ -70,6 +75,11 @@ class PackedCollate:
         vocab_size=self._vocab_size, mask_id=self._mask_id,
         base_seed=self._base_seed, dp_rank=self._dp_rank, epoch=epoch,
         step=step)
+    if tele.enabled:
+      tele.histogram(f'loader.collate_seconds.s{seq_len}').observe(
+          time.monotonic() - t0)
+      tele.counter('loader.batches').add(1)
+      tele.counter('loader.collated_rows').add(n)
     return {
         'input_ids': input_ids,
         'token_type_ids': token_type_ids,

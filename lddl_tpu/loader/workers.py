@@ -25,7 +25,10 @@ magnitude cheaper than collate.
 import multiprocessing as _mp
 import queue as _queue
 import sys
+import time
 import traceback
+
+from ..telemetry import get_telemetry
 
 
 def _mp_context():
@@ -111,13 +114,17 @@ class MultiprocessLoader:
   def epoch(self, value):
     self._serial.epoch = value
 
-  def _get(self, q, proc, w):
+  def _get(self, q, proc, w, stall_hist):
     """Queue get that fails fast (naming the worker) on a dead producer
     instead of blocking forever — a hard-killed worker sends no
-    sentinel."""
+    sentinel. Time blocked here is the parent's pull stall: the workers
+    could not keep a batch ready ahead of the consumer."""
+    t0 = time.monotonic()
     while True:
       try:
-        return q.get(timeout=5)
+        item = q.get(timeout=5)
+        stall_hist.observe(time.monotonic() - t0)
+        return item
       except _queue.Empty:
         if not proc.is_alive():
           raise RuntimeError(
@@ -132,6 +139,9 @@ class MultiprocessLoader:
     # moment an iteration starts (bert.py _make_iterator), so len() of an
     # abandoned-then-restarted epoch reports the full count either way.
     self._serial._batches_consumed = 0
+    tele = get_telemetry()
+    stall_h = tele.histogram('loader.pull_stall_seconds')
+    depth_g = tele.gauge('loader.queue_depth')
     ctx = _mp_context()
     queues = [ctx.Queue(maxsize=4) for _ in range(self._num_workers)]
     procs = [
@@ -147,7 +157,12 @@ class MultiprocessLoader:
     try:
       while True:
         w = step % self._num_workers
-        kind, a, b = self._get(queues[w], procs[w], w)
+        if tele.enabled:
+          try:  # qsize is advisory (and absent on some platforms)
+            depth_g.set(sum(q.qsize() for q in queues))
+          except NotImplementedError:
+            pass
+        kind, a, b = self._get(queues[w], procs[w], w, stall_h)
         if kind == 'batch':
           assert a == step, f'worker {w} sent step {a}, expected {step}'
           yield b

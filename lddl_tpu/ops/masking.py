@@ -270,6 +270,21 @@ def _mask_partition_numpy(flat_ids, a_ranges, b_ranges, na, nb, offs_a,
   return flat_a, flat_b, pos, label_ids
 
 
+def _check_offsets(name, offs, lens):
+  """Caller-provided output offsets must be the exact cumsum of the
+  segment lengths: the native kernel scatters through them unchecked, so
+  a mismatched array means silent out-of-bounds writes, not an error."""
+  offs = np.asarray(offs)
+  n = len(lens)
+  if offs.shape != (n + 1,):
+    raise ValueError(
+        f'{name} must have shape ({n + 1},), got {offs.shape}')
+  if int(offs[0]) != 0 or not np.array_equal(np.diff(offs), lens):
+    raise ValueError(
+        f'{name} is not the cumulative sum of the segment lengths '
+        '(expected offs[0] == 0 and diff(offs) == lengths)')
+
+
 def mask_partition_host(flat_ids, a_ranges, b_ranges, *, masked_lm_ratio,
                         vocab_size, mask_id, seed, max_predictions=None,
                         offs_a=None, offs_b=None):
@@ -300,9 +315,13 @@ def mask_partition_host(flat_ids, a_ranges, b_ranges, *, masked_lm_ratio,
   if offs_a is None:
     offs_a = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(na, out=offs_a[1:])
+  else:
+    _check_offsets('offs_a', offs_a, na)
   if offs_b is None:
     offs_b = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(nb, out=offs_b[1:])
+  else:
+    _check_offsets('offs_b', offs_b, nb)
   k = _pick_counts(na, nb, masked_lm_ratio, max_predictions)
   offs_k = np.zeros(n + 1, dtype=np.int64)
   np.cumsum(k, out=offs_k[1:])

@@ -23,10 +23,15 @@ import tempfile
 import time
 
 from ..comm import NullBackend
+from ..telemetry import get_telemetry
 
 
 def _run_task(fn, global_index, task):
-  return global_index, fn(task, global_index)
+  # Timed inside the (possibly pooled) worker so the duration is true
+  # task latency, not submit-to-completion time inflated by queueing.
+  t0 = time.monotonic()
+  result = fn(task, global_index)
+  return global_index, result, time.monotonic() - t0
 
 
 class ProgressReporter:
@@ -139,10 +144,18 @@ class Executor:
     world = self._comm.world_size
     my_indices = list(range(rank, len(tasks), world))
     total = len(my_indices)
+    tele = get_telemetry()
+    task_hist = tele.histogram(f'pipeline.{label}.task_seconds')
+    tasks_done = tele.counter(f'pipeline.{label}.tasks')
     local_results = []
+    map_span = tele.span(f'pipeline.{label}.map_seconds')
+    map_span.__enter__()
     if self._num_local_workers <= 1 or len(my_indices) <= 1:
       for i in my_indices:
-        local_results.append(_run_task(fn, i, tasks[i]))
+        gi, res, dt = _run_task(fn, i, tasks[i])
+        task_hist.observe(dt)
+        tasks_done.add(1)
+        local_results.append((gi, res))
         if self._progress:
           self._progress.update(label, len(local_results), total,
                                 force=len(local_results) == total)
@@ -159,7 +172,11 @@ class Executor:
             done += 1
             self._progress.update(label, done, total, force=done == total)
         for fut in futures:
-          local_results.append(fut.result())
+          gi, res, dt = fut.result()
+          task_hist.observe(dt)
+          tasks_done.add(1)
+          local_results.append((gi, res))
+    map_span.__exit__(None, None, None)
     if not gather:
       self._comm.barrier()
       return local_results

@@ -111,6 +111,14 @@ def pack_documents(docs, cls_id, sep_id, target_seq_length):
       if space <= 0:
         flush()
         continue
+      if len(ids) > space and cur_len > 1 and len(ids) <= budget - 2:
+        # The doc overflows this row's remainder but fits a fresh row
+        # whole ([CLS] + doc + [SEP] <= budget): start a new row rather
+        # than splitting it — only docs longer than a whole row are
+        # chunked. cur_len > 1 guarantees progress: an empty row is
+        # never flushed, so a doc is only deferred once.
+        flush()
+        continue
       piece, ids = ids[:space], ids[space:]
       cur_marks.append(cur_len)
       cur.append(piece)

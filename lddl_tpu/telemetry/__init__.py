@@ -1,0 +1,32 @@
+"""Pipeline-wide telemetry: metrics, timing spans, and cross-rank
+stall attribution.
+
+The observability layer every perf round reports through: counters,
+gauges, and log-bucketed histograms with monotonic-clock timing spans,
+threaded through the pipeline executor, the loader stack, the comm
+backends, and the training loop. Disabled by default and a strict
+no-op when off (env ``LDDL_TELEMETRY=0``/unset): the disabled path is
+shared immutable singletons — no locks, no per-event allocation — so
+hot loops can stay instrumented unconditionally.
+
+Per-rank snapshots export as JSONL (``telemetry.rank<R>.jsonl``);
+cross-rank aggregation rides :meth:`CommBackend.allgather_object`;
+``python -m lddl_tpu.cli telemetry-report`` merges rank files into a
+per-stage summary naming the bottleneck stage.
+"""
+
+from .metrics import (
+    NOOP,
+    NoopTelemetry,
+    Telemetry,
+    disable,
+    enable,
+    get_telemetry,
+    rank_file_name,
+)
+from .report import (
+    aggregate_over_comm,
+    load_rank_files,
+    merge_metric_lines,
+    render_report,
+)

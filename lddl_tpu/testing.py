@@ -62,8 +62,20 @@ def hash_parquets(directory):
   return out
 
 
+def _sample_key(row, with_positions):
+  """Identity key of one raw sample, shared by the drain and the on-disk
+  scan so the two sides can never disagree on key shape. The stored mask
+  positions (when the shard carries them) are part of the key by
+  default: random word-salad pairs can collide on (A, B, is_random_next)
+  alone, which made the disjointness assert flake."""
+  key = (row['A'], row['B'], bool(row['is_random_next']))
+  if with_positions and 'masked_lm_positions' in row:
+    key += (bytes(row['masked_lm_positions']),)
+  return key
+
+
 def drain_rank_keys(balanced_dir, rank, world, bin_size, base_seed,
-                    with_positions=False):
+                    with_positions=True):
   """Drain one dp rank's full epoch of raw rows; returns sample keys.
 
   The exact-drain assert inside the binned iterator fires if violated.
@@ -83,10 +95,7 @@ def drain_rank_keys(balanced_dir, rank, world, bin_size, base_seed,
   keys = []
   for rows in loader:
     for row in rows:
-      key = (row['A'], row['B'], bool(row['is_random_next']))
-      if with_positions:
-        key += (bytes(row['masked_lm_positions']),)
-      keys.append(key)
+      keys.append(_sample_key(row, with_positions))
   return keys
 
 
@@ -107,7 +116,7 @@ def expected_min_truncated_rows(balanced_dir):
 
 
 def check_dp_drains(balanced_dir, world, bin_size, base_seed,
-                    drained_keys=None, with_positions=False):
+                    drained_keys=None, with_positions=True):
   """Assert the dp ranks' drains are pairwise disjoint, cover exactly the
   min-truncated per-bin row count, and consist of real on-disk rows.
   ``drained_keys``: per-rank key lists (drained here when omitted).
@@ -129,9 +138,6 @@ def check_dp_drains(balanced_dir, world, bin_size, base_seed,
   on_disk = set()
   for p in get_all_parquets_under(balanced_dir):
     for row in read_samples(p):
-      key = (row['A'], row['B'], bool(row['is_random_next']))
-      if with_positions:
-        key += (bytes(row['masked_lm_positions']),)
-      on_disk.add(key)
+      on_disk.add(_sample_key(row, with_positions))
   assert set(all_keys) <= on_disk
   return len(all_keys)

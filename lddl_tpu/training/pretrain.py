@@ -21,6 +21,7 @@ CLI: ``python -m lddl_tpu.cli pretrain_bert --path <balanced> ...``.
 
 import argparse
 import dataclasses
+import functools
 import json
 import logging
 import os
@@ -70,6 +71,9 @@ class TrainLoop:
   step_fn: object
   samples_seen: int = 0
   step: int = 0
+  # (per_rank_batch, seq_len) -> analytic FLOPs of one train step; set by
+  # build() so run() can report MFU without re-deriving the model config.
+  flops_fn: object = None
   _last_saved: int = dataclasses.field(default=-1, repr=False)
 
   @classmethod
@@ -133,10 +137,13 @@ class TrainLoop:
     step_fn = make_train_step(model, tx, mesh,
                               max_predictions=max_predictions)
     global_batch = batch_size_per_rank * dp_world
+    from ..models.flops import bert_pretrain_flops_per_step
+    flops_fn = functools.partial(bert_pretrain_flops_per_step, model_cfg,
+                                 max_predictions=max_predictions)
     return cls(model=model, tx=tx, mesh=mesh, loader=loader, params=params,
                opt_state=opt_state, rng=jax.random.key(seed + 1),
                step_fn=step_fn, samples_seen=samples_seen,
-               step=samples_seen // global_batch)
+               step=samples_seen // global_batch, flops_fn=flops_fn)
 
   # ---- checkpointing ----
 
@@ -235,22 +242,55 @@ class TrainLoop:
     import jax
 
     from ..loader.device import prefetch_to_device
+    from ..telemetry import get_telemetry
 
     global_batch = self.loader.batch_size * max(jax.process_count(), 1)
+    tele = get_telemetry()
+    data_wait_h = tele.histogram('train.data_wait_seconds')
+    compute_h = tele.histogram('train.compute_seconds')
+    step_h = tele.histogram('train.step_seconds')
+    steps_c = tele.counter('train.steps')
+    samples_c = tele.counter('train.samples')
+    peak_total = _peak_flops_total() if tele.enabled else None
     losses = []
     while self.step < max_steps:
       stream = prefetch_to_device(iter(self.loader), mesh=self.mesh,
                                   size=prefetch)
       t0 = time.perf_counter()
       steps_this_epoch = 0
-      for batch in stream:
+      while True:
+        # Pull the batch explicitly so the stall waiting on the input
+        # pipeline (data wait) is timed separately from the step itself:
+        # the split is the report's loader-vs-compute bottleneck signal.
+        t_wait = time.perf_counter()
+        try:
+          batch = next(stream)
+        except StopIteration:
+          break
+        t_step = time.perf_counter()
+        data_wait_h.observe(t_step - t_wait)
         steps_this_epoch += 1
         self.params, self.opt_state, metrics = self.step_fn(
             self.params, self.opt_state, self.rng, batch)
+        # float() blocks until the device finishes the step, so the
+        # compute span covers real execution, not just dispatch.
         loss = float(metrics['loss'])
         losses.append(loss)
         self.step += 1
         self.samples_seen += global_batch
+        if tele.enabled:
+          now = time.perf_counter()
+          compute_h.observe(now - t_step)
+          step_h.observe(now - t_wait)
+          steps_c.add(1)
+          samples_c.add(self.loader.batch_size)
+          tele.gauge('train.samples_per_sec').set(
+              self.loader.batch_size / max(now - t_wait, 1e-9))
+          if peak_total and self.flops_fn is not None:
+            b, s = batch['input_ids'].shape
+            tele.gauge('train.mfu').set(
+                self.flops_fn(b, s) /
+                (max(now - t_wait, 1e-9) * peak_total))
         if log_every and self.step % log_every == 0:
           dt = time.perf_counter() - t0
           t0 = time.perf_counter()
@@ -273,6 +313,45 @@ class TrainLoop:
     if ckpt_dir and self._last_saved != self.step:
       self.save(ckpt_dir)
     return losses
+
+
+def _peak_flops_total():
+  """Per-process peak FLOP/s for the MFU denominator: per-device peak x
+  local device count. ``LDDL_PEAK_TFLOPS`` (per device, in TFLOP/s)
+  overrides the chip table — required on hosts the table cannot identify
+  (CPU runs, unreleased chips), where it returns None and MFU is
+  omitted."""
+  import jax
+
+  from ..models.flops import peak_flops_per_device
+  env = os.environ.get('LDDL_PEAK_TFLOPS')
+  per_device = float(env) * 1e12 if env else peak_flops_per_device()
+  if not per_device:
+    return None
+  return per_device * jax.local_device_count()
+
+
+def export_telemetry(comm):
+  """Per-rank JSONL + rank-0 merged stall report, when telemetry is on.
+
+  Every rank writes ``telemetry.rank<R>.jsonl`` under
+  ``LDDL_TELEMETRY_DIR`` (skipped when unset), then the snapshots are
+  merged over the run's own comm backend and rank 0 prints the
+  cross-rank report. No-op (and free) when ``LDDL_TELEMETRY`` is off.
+  """
+  from ..telemetry import get_telemetry, rank_file_name
+  tele = get_telemetry()
+  if not tele.enabled:
+    return None
+  out_dir = os.environ.get('LDDL_TELEMETRY_DIR')
+  if out_dir:
+    os.makedirs(out_dir, exist_ok=True)
+    tele.write_jsonl(rank_file_name(out_dir, comm.rank), rank=comm.rank)
+  from ..telemetry.report import aggregate_over_comm, render_report
+  merged = aggregate_over_comm(comm)
+  if comm.rank == 0:
+    print(render_report(merged))
+  return merged
 
 
 MODEL_SIZES = {
@@ -354,7 +433,7 @@ def main(args=None):
   from ..parallel import make_mesh, mesh_summary
   from ..tokenization.wordpiece import load_bert_tokenizer
 
-  get_backend(args.comm)  # bootstraps jax.distributed under --comm jax
+  comm = get_backend(args.comm)  # bootstraps jax.distributed under --comm jax
   tokenizer = load_bert_tokenizer(
       vocab_file=args.vocab_file, hub_name=args.tokenizer, backend='hf')
   vocab = ((tokenizer.vocab_size + 63) // 64) * 64
@@ -392,6 +471,7 @@ def main(args=None):
   losses = loop.run(args.steps, ckpt_dir=args.checkpoint_dir,
                     ckpt_every=args.checkpoint_every,
                     log_every=args.log_every)
+  export_telemetry(comm)
   if losses:
     print(json.dumps({'final_step': loop.step,
                       'final_loss': round(losses[-1], 4),

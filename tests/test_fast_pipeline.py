@@ -268,6 +268,39 @@ class TestRaggedMaskParity:
         assert x.dtype == y.dtype, name
         assert np.array_equal(x, y), name
 
+  def test_bogus_offsets_rejected(self):
+    """Caller-supplied offs_a/offs_b feed the native kernel's scatter
+    unchecked, so anything that is not the exact cumsum of the segment
+    lengths must raise instead of silently writing out of bounds."""
+    from lddl_tpu.ops import mask_partition_host
+    flat = (np.arange(500, dtype=np.int32) * 3) % 20000 + 10
+    a_ranges = np.array([[0, 20], [50, 80]], np.int64)
+    b_ranges = np.array([[100, 130], [200, 210]], np.int64)
+    kw = dict(masked_lm_ratio=0.15, vocab_size=20000, mask_id=4, seed=9)
+    na = a_ranges[:, 1] - a_ranges[:, 0]
+    nb = b_ranges[:, 1] - b_ranges[:, 0]
+    good_a = np.zeros(3, np.int64)
+    np.cumsum(na, out=good_a[1:])
+    good_b = np.zeros(3, np.int64)
+    np.cumsum(nb, out=good_b[1:])
+    baseline = mask_partition_host(flat, a_ranges, b_ranges, **kw)
+    # correct explicit offsets reproduce the default path bit-for-bit
+    explicit = mask_partition_host(flat, a_ranges, b_ranges,
+                                   offs_a=good_a, offs_b=good_b, **kw)
+    for x, y in zip(baseline, explicit):
+      assert np.array_equal(x, y)
+    with pytest.raises(ValueError, match='offs_a'):
+      mask_partition_host(flat, a_ranges, b_ranges,
+                          offs_a=good_a[:-1], offs_b=good_b, **kw)
+    bad = good_a.copy()
+    bad[1] += 1  # not the cumsum of na
+    with pytest.raises(ValueError, match='offs_a'):
+      mask_partition_host(flat, a_ranges, b_ranges,
+                          offs_a=bad, offs_b=good_b, **kw)
+    with pytest.raises(ValueError, match='offs_b'):
+      mask_partition_host(flat, a_ranges, b_ranges,
+                          offs_a=good_a, offs_b=good_b + 1, **kw)
+
   def test_structure_and_determinism(self):
     from lddl_tpu.ops import mask_partition_host
     flat = (np.arange(2000, dtype=np.int32) * 7) % 25000 + 10

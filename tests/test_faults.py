@@ -96,3 +96,36 @@ class TestCommRankDeath:
     assert elapsed < 30.0, (
         f'survivors took {elapsed:.0f}s — the liveness fast-path should '
         'beat the 60s timeout by a wide margin')
+
+
+def _publish_and_exit(rendezvous, rank, world):
+  """Write the liveness beacon + this rank's collective-#0 payload, then
+  exit — the 'last rank of a finishing job' shape."""
+  import pickle
+  be = FileBackend(rendezvous, rank, world, timeout=60.0, run_id='race')
+  be._write_atomic(pickle.dumps(f'r{rank}'), be._path(0, rank))
+
+
+class TestPeerDeathPublishRace:
+
+  def test_dead_peer_with_published_payload_does_not_raise(self, tmp_path):
+    """A peer whose last act was publishing its payload for collective
+    #N and exiting cleanly must not trip the survivors' fail-fast path:
+    the payload re-check in _check_peer_alive (comm/backend.py) closes
+    the stat-poll/liveness-probe race. A collective the peer never
+    published still fails fast."""
+    world = 2
+    ctx = multiprocessing.get_context('spawn')
+    p = ctx.Process(target=_publish_and_exit,
+                    args=(str(tmp_path), 1, world))
+    p.start()
+    p.join(timeout=60)
+    assert p.exitcode == 0
+    be = FileBackend(str(tmp_path), 0, world, timeout=10.0, run_id='race')
+    # rank 1 is positively dead, but its op0 payload exists: no raise.
+    be._check_peer_alive(1, 0)
+    # ...while a collective it never entered still names the dead rank.
+    with pytest.raises(RuntimeError, match=r'rank 1 .* died'):
+      be._check_peer_alive(1, 1)
+    # and rank 0's side of collective #0 completes normally.
+    assert be.allgather_object('r0') == ['r0', 'r1']

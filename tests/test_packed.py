@@ -72,6 +72,45 @@ class TestPackDocuments:
     original = docs.flat_ids
     assert np.array_equal(np.concatenate(recovered), original)
 
+  def test_doc_that_fits_a_row_is_never_split(self, tmp_path, tiny_vocab):
+    """A document that overflows the current row's remainder but fits a
+    whole row starts a new row instead of being split (the docstring
+    contract; only docs longer than a full row are chunked)."""
+    tok = load_bert_tokenizer(vocab_file=tiny_vocab, backend='hf')
+    # doc0 fills most of row 0; doc1 (9 tokens) doesn't fit the
+    # remainder but fits a fresh row whole.
+    texts = [
+        'Alpha bravo charlie delta echo foxtrot golf hotel india juliet '
+        'kilo lima mike november.',
+        'Alpha bravo charlie delta echo foxtrot golf hotel india.',
+    ]
+    docs = encode_documents(texts, tok, sentence_backend='rules')
+    target = 20
+    flat_rows, row_offsets, flat_marks, mark_offsets = packed.pack_documents(
+        docs, tok.cls_token_id, tok.sep_token_id, target)
+    n = len(row_offsets) - 1
+    # Each document's tokens must sit in exactly one contiguous row span:
+    # walking docs against rows, a doc that fits a row never straddles a
+    # row boundary.
+    doc_lens = [
+        int(docs.sent_offsets[docs.doc_sent_start[d + 1]]) -
+        int(docs.sent_offsets[docs.doc_sent_start[d]])
+        for d in range(len(docs))
+    ]
+    assert all(l <= target - 2 for l in doc_lens), 'fixture docs must fit'
+    pieces_per_row = [
+        int(mark_offsets[r + 1] - mark_offsets[r]) for r in range(n)
+    ]
+    assert sum(pieces_per_row) == len(docs), (
+        'every doc lands whole in exactly one row (no split pieces)')
+    # and the roundtrip still holds
+    recovered = np.concatenate([
+        flat_rows[row_offsets[r]:row_offsets[r + 1]] for r in range(n)
+    ])
+    body = recovered[(recovered != tok.cls_token_id)
+                     & (recovered != tok.sep_token_id)]
+    assert np.array_equal(body, docs.flat_ids)
+
   def test_budget_split_long_doc(self, tmp_path, tiny_vocab):
     tok = load_bert_tokenizer(vocab_file=tiny_vocab, backend='hf')
     texts = ['Alpha bravo charlie delta echo foxtrot golf hotel india '
