@@ -44,6 +44,39 @@ _VOCAB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       'benchmarks', 'assets', 'bench_vocab_30522.txt')
 
 
+def _telemetry_artifacts():
+  """Export telemetry/trace artifacts for this bench run, when enabled.
+
+  With ``LDDL_TELEMETRY=1`` and/or ``LDDL_TRACE=1`` the run's metric
+  snapshot and trace buffer are written under ``LDDL_TELEMETRY_DIR`` (a
+  fresh persistent temp dir when unset) and the bottleneck verdict is
+  embedded in the printed JSON line — BENCH captures carry their own
+  attribution instead of needing a manual telemetry run. Returns the
+  extra JSON fields ({} when both are off).
+  """
+  from lddl_tpu.telemetry import get_telemetry, rank_file_name
+  from lddl_tpu.telemetry.trace import get_tracer, trace_file_name
+  tele = get_telemetry()
+  tracer = get_tracer()
+  if not (tele.enabled or tracer.enabled):
+    return {}
+  out_dir = os.environ.get('LDDL_TELEMETRY_DIR') or tempfile.mkdtemp(
+      prefix='lddl_bench_telemetry_')
+  extra = {'telemetry_dir': out_dir}
+  if tele.enabled:
+    tele.write_jsonl(rank_file_name(out_dir, 0))
+    from lddl_tpu.telemetry.report import (merge_metric_lines,
+                                           summarize_stages)
+    verdict = summarize_stages(
+        merge_metric_lines([tele.snapshot_lines(rank=0)]))
+    extra['bottleneck'] = verdict['bottleneck']
+    if verdict.get('detail'):
+      extra['bottleneck_detail'] = verdict['detail']
+  if tracer.enabled:
+    tracer.write_jsonl(trace_file_name(out_dir, 0))
+  return extra
+
+
 def _reference_style_partition(lines, hf_tok, vocab_words, seed,
                                duplicate_factor=5):
   """The reference's per-partition hot loop, reimplemented faithfully:
@@ -161,13 +194,15 @@ def main():
     ref_s = time.perf_counter() - t0
     ref_mbps = (nbytes / (1024 * 1024)) / ref_s / num_chips
 
-    print(json.dumps({
+    result = {
         'metric': 'bert_preprocess_mb_per_sec_per_chip',
         'value': round(ours_mbps, 3),
         'unit': 'MB/s/chip',
         'vs_baseline': round(ours_mbps / ref_mbps, 3),
         'dup1_mb_per_sec_per_chip': round(dup1_mbps, 3),
-    }))
+    }
+    result.update(_telemetry_artifacts())
+    print(json.dumps(result))
   finally:
     shutil.rmtree(work, ignore_errors=True)
 

@@ -13,6 +13,10 @@ fork's CodeBERT wrapper), all thin delegates:
   telemetry_report               -> lddl_tpu.telemetry.report (merge
                                     per-rank telemetry JSONL into a
                                     per-stage bottleneck summary)
+  telemetry_trace                -> lddl_tpu.telemetry.trace (merge
+                                    per-rank trace JSONL into one
+                                    clock-aligned Chrome-trace JSON
+                                    for Perfetto / chrome://tracing)
 
 Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
 installed console scripts.
@@ -86,6 +90,11 @@ def telemetry_report(args=None):
   return main(args)
 
 
+def telemetry_trace(args=None):
+  from .telemetry.trace import main
+  return main(args)
+
+
 _COMMANDS = {
     'download_wikipedia': download_wikipedia,
     'download_books': download_books,
@@ -102,6 +111,8 @@ _COMMANDS = {
     'generate_num_samples_cache': generate_num_samples_cache,
     'telemetry_report': telemetry_report,
     'telemetry-report': telemetry_report,  # dash-form alias
+    'telemetry_trace': telemetry_trace,
+    'telemetry-trace': telemetry_trace,  # dash-form alias
 }
 
 

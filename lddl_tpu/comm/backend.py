@@ -26,6 +26,7 @@ import time
 import numpy as np
 
 from ..telemetry import get_telemetry
+from ..telemetry.trace import get_tracer
 
 
 class CommBackend:
@@ -236,7 +237,8 @@ class FileBackend(CommBackend):
 
   def allgather_object(self, obj):
     tele = get_telemetry()
-    t_start = time.monotonic() if tele.enabled else 0.0
+    tracer = get_tracer()
+    t_start = time.monotonic() if (tele.enabled or tracer.enabled) else 0.0
     seq = self._seq
     self._seq += 1
     # Publish progress (highest collective this rank has *entered* — all
@@ -277,6 +279,12 @@ class FileBackend(CommBackend):
       tele.histogram('comm.allgather_seconds').observe(
           time.monotonic() - t_start)
       tele.counter('comm.allgathers').add(1)
+    if tracer.enabled:
+      # The seq number keys cross-rank event matching: all ranks finish
+      # collective #seq within one collective latency, so the trace
+      # merger refines per-rank clock offsets from these events.
+      tracer.complete('comm.allgather', t_start,
+                      time.monotonic() - t_start, args={'seq': seq})
     return results
 
 
@@ -337,6 +345,9 @@ class JaxProcessBackend(CommBackend):
   def __init__(self, initialize=True):
     import jax
     self._jax = jax
+    # Collective sequence number for trace-event matching across ranks
+    # (all ranks issue the same collective sequence by construction).
+    self._seq = 0
     if initialize:
       ensure_jax_distributed()
 
@@ -351,7 +362,10 @@ class JaxProcessBackend(CommBackend):
   def allgather_object(self, obj):
     from jax.experimental import multihost_utils
     tele = get_telemetry()
-    t_start = time.monotonic() if tele.enabled else 0.0
+    tracer = get_tracer()
+    t_start = time.monotonic() if (tele.enabled or tracer.enabled) else 0.0
+    seq = self._seq
+    self._seq += 1
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     # Pad to the max payload size across ranks so shapes are uniform.
     sizes = multihost_utils.process_allgather(
@@ -369,6 +383,9 @@ class JaxProcessBackend(CommBackend):
       tele.histogram('comm.allgather_seconds').observe(
           time.monotonic() - t_start)
       tele.counter('comm.allgathers').add(1)
+    if tracer.enabled:
+      tracer.complete('comm.allgather', t_start,
+                      time.monotonic() - t_start, args={'seq': seq})
     return out
 
   def allreduce_sum(self, array):
@@ -379,8 +396,15 @@ class JaxProcessBackend(CommBackend):
 
   def barrier(self):
     from jax.experimental import multihost_utils
+    tracer = get_tracer()
+    seq = self._seq
+    self._seq += 1
+    t0 = time.monotonic() if tracer.enabled else 0.0
     with get_telemetry().histogram('comm.barrier_seconds').time():
       multihost_utils.sync_global_devices('lddl_tpu_barrier')
+    if tracer.enabled:
+      tracer.complete('comm.barrier', t0, time.monotonic() - t0,
+                      args={'seq': seq})
 
 
 def get_backend(name=None, **kwargs):

@@ -34,6 +34,7 @@ from ..comm import get_backend
 from ..core.utils import (get_all_bin_ids, get_all_parquets_under,
                           get_file_paths_for_bin_id)
 from ..telemetry import get_telemetry
+from ..telemetry.trace import get_tracer
 from .binned import BinnedIterator
 from .dataset import ParquetShardDataset
 
@@ -101,7 +102,8 @@ class BertCollate:
     call per batch, then ragged scatter via ``np.repeat``/cumsum index
     arithmetic builds every array in whole-batch numpy ops."""
     tele = get_telemetry()
-    t0 = time.monotonic() if tele.enabled else 0.0
+    tracer = get_tracer()
+    t0 = time.monotonic() if (tele.enabled or tracer.enabled) else 0.0
     n = len(rows)
     arange_n = np.arange(n)
     cols = np.arange(seq_len)
@@ -191,6 +193,9 @@ class BertCollate:
           time.monotonic() - t0)
       tele.counter('loader.batches').add(1)
       tele.counter('loader.collated_rows').add(n)
+    if tracer.enabled:
+      tracer.complete(f'loader.collate.s{seq_len}', t0,
+                      time.monotonic() - t0, args={'step': step, 'rows': n})
     return {
         'input_ids': input_ids,
         'token_type_ids': token_type_ids,

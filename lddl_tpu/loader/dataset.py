@@ -33,6 +33,7 @@ from ..balance import load_num_samples_cache
 from ..core.random import rng_from_key
 from ..core.utils import count_parquet_samples_strided
 from ..telemetry import get_telemetry
+from ..telemetry.trace import get_tracer
 from .shuffle_buffer import ShuffleBuffer
 
 
@@ -149,6 +150,7 @@ class ParquetShardDataset:
     # disabled mode they are the shared no-op singletons, so the per-row
     # cost is one empty method call.
     tele = get_telemetry()
+    tracer = get_tracer()
     rows_c = tele.counter('loader.rows')
     decode_h = tele.histogram('loader.read_batch_seconds')
     for fi, path in enumerate(files):
@@ -165,7 +167,7 @@ class ParquetShardDataset:
         if to_skip >= take:
           to_skip -= take
           continue
-        with decode_h.time():
+        with decode_h.time(), tracer.span('loader.read_batch'):
           cols = {name: batch.column(i).to_pylist()
                   for i, name in enumerate(batch.schema.names)}
         n = take

@@ -24,6 +24,8 @@ import threading
 import jax
 from jax.sharding import NamedSharding
 
+from ..telemetry.trace import get_tracer
+
 
 def make_global_batch(batch, mesh, data_axis=None, seq_axis=None):
   """Shard a dict of per-process numpy arrays with the canonical batch
@@ -80,10 +82,16 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
         continue
     return False
 
+  tracer = get_tracer()
+
   def _producer():
     try:
       for item in iterator:
-        if not _blocking_put(_put(item)):
+        # The host-to-device transfer phase, on the producer thread's
+        # own trace lane (overlaps the main thread's compute span).
+        with tracer.span('train.h2d'):
+          placed = _put(item)
+        if not _blocking_put(placed):
           return
     except BaseException as e:  # propagate into the consumer
       err.append(e)

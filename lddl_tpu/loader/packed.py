@@ -25,6 +25,7 @@ import numpy as np
 
 from ..core.utils import deserialize_np_array
 from ..telemetry import get_telemetry
+from ..telemetry.trace import get_tracer
 from .bert import build_pretrain_loader, dynamic_mask_tokens
 
 
@@ -44,7 +45,8 @@ class PackedCollate:
 
   def __call__(self, rows, seq_len, epoch, step):
     tele = get_telemetry()
-    t0 = time.monotonic() if tele.enabled else 0.0
+    tracer = get_tracer()
+    t0 = time.monotonic() if (tele.enabled or tracer.enabled) else 0.0
     n = len(rows)
     ids_arrays = [
         deserialize_np_array(row['input_ids']).astype(np.int32)
@@ -80,6 +82,9 @@ class PackedCollate:
           time.monotonic() - t0)
       tele.counter('loader.batches').add(1)
       tele.counter('loader.collated_rows').add(n)
+    if tracer.enabled:
+      tracer.complete(f'loader.collate.s{seq_len}', t0,
+                      time.monotonic() - t0, args={'step': step, 'rows': n})
     return {
         'input_ids': input_ids,
         'token_type_ids': token_type_ids,

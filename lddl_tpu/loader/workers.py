@@ -29,6 +29,7 @@ import time
 import traceback
 
 from ..telemetry import get_telemetry
+from ..telemetry.trace import get_tracer
 
 
 def _mp_context():
@@ -53,6 +54,12 @@ def _resolve_factory(factory):
 
 def _worker_main(build_kwargs, factory, epoch, clear_consumed, w,
                  num_workers, q):
+  tracer = get_tracer()
+  if tracer.enabled:
+    # Fresh buffer under this worker's own identity: a forked child
+    # inherits the parent's event buffer, and each worker must flush to
+    # its own trace.rank<R>.pid<P>.jsonl file.
+    tracer.reset(rank=int(build_kwargs.get('dp_rank') or 0), per_pid=True)
   try:
     loader = _resolve_factory(factory)(**build_kwargs)
     loader.epoch = epoch
@@ -60,10 +67,16 @@ def _worker_main(build_kwargs, factory, epoch, clear_consumed, w,
       loader._batches_consumed = 0
     for step, batch in loader.iter_steps((w, num_workers)):
       q.put(('batch', step, batch))
+    # Flush before signalling 'done': the parent may terminate() this
+    # process the moment it sees the sentinel, which would race a
+    # flush placed after it.
+    tracer.flush()
     q.put(('done', w, None))
   except BaseException:
     q.put(('error', w, traceback.format_exc()))
     raise
+  finally:
+    tracer.flush()  # crash/error path still leaves a tail
 
 
 class MultiprocessLoader:
@@ -140,6 +153,7 @@ class MultiprocessLoader:
     # abandoned-then-restarted epoch reports the full count either way.
     self._serial._batches_consumed = 0
     tele = get_telemetry()
+    tracer = get_tracer()
     stall_h = tele.histogram('loader.pull_stall_seconds')
     depth_g = tele.gauge('loader.queue_depth')
     ctx = _mp_context()
@@ -157,12 +171,19 @@ class MultiprocessLoader:
     try:
       while True:
         w = step % self._num_workers
-        if tele.enabled:
+        if tele.enabled or tracer.enabled:
           try:  # qsize is advisory (and absent on some platforms)
-            depth_g.set(sum(q.qsize() for q in queues))
+            depth = sum(q.qsize() for q in queues)
           except NotImplementedError:
-            pass
+            depth = None
+          if depth is not None:
+            depth_g.set(depth)
+            tracer.counter('loader.queue_depth', depth)
+        t_pull = time.monotonic() if tracer.enabled else 0.0
         kind, a, b = self._get(queues[w], procs[w], w, stall_h)
+        if tracer.enabled:
+          tracer.complete('loader.pull', t_pull, time.monotonic() - t_pull,
+                          args={'worker': w, 'step': step})
         if kind == 'batch':
           assert a == step, f'worker {w} sent step {a}, expected {step}'
           yield b

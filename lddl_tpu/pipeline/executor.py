@@ -24,14 +24,19 @@ import time
 
 from ..comm import NullBackend
 from ..telemetry import get_telemetry
+from ..telemetry.trace import get_tracer
 
 
 def _run_task(fn, global_index, task):
   # Timed inside the (possibly pooled) worker so the duration is true
   # task latency, not submit-to-completion time inflated by queueing.
+  # The start timestamp and worker pid ride back with the result:
+  # CLOCK_MONOTONIC is machine-wide, so the parent can place the span on
+  # the merged timeline (one trace lane per pool worker) without the
+  # worker owning a trace buffer of its own.
   t0 = time.monotonic()
   result = fn(task, global_index)
-  return global_index, result, time.monotonic() - t0
+  return global_index, result, t0, time.monotonic() - t0, os.getpid()
 
 
 class ProgressReporter:
@@ -145,16 +150,22 @@ class Executor:
     my_indices = list(range(rank, len(tasks), world))
     total = len(my_indices)
     tele = get_telemetry()
+    tracer = get_tracer()
+    if tracer.enabled:
+      tracer.set_identity(rank=rank)
+    task_name = f'pipeline.{label}.task'
     task_hist = tele.histogram(f'pipeline.{label}.task_seconds')
     tasks_done = tele.counter(f'pipeline.{label}.tasks')
     local_results = []
     map_span = tele.span(f'pipeline.{label}.map_seconds')
+    t_map = time.monotonic()
     map_span.__enter__()
     if self._num_local_workers <= 1 or len(my_indices) <= 1:
       for i in my_indices:
-        gi, res, dt = _run_task(fn, i, tasks[i])
+        gi, res, t0, dt, pid = _run_task(fn, i, tasks[i])
         task_hist.observe(dt)
         tasks_done.add(1)
+        tracer.complete(task_name, t0, dt, tid=pid)
         local_results.append((gi, res))
         if self._progress:
           self._progress.update(label, len(local_results), total,
@@ -172,11 +183,16 @@ class Executor:
             done += 1
             self._progress.update(label, done, total, force=done == total)
         for fut in futures:
-          gi, res, dt = fut.result()
+          gi, res, t0, dt, pid = fut.result()
           task_hist.observe(dt)
           tasks_done.add(1)
+          tracer.complete(task_name, t0, dt, tid=pid)
           local_results.append((gi, res))
     map_span.__exit__(None, None, None)
+    if tracer.enabled:
+      tracer.complete(f'pipeline.{label}.map', t_map,
+                      time.monotonic() - t_map,
+                      args={'tasks': len(my_indices)})
     if not gather:
       self._comm.barrier()
       return local_results

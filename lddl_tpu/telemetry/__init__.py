@@ -13,6 +13,12 @@ Per-rank snapshots export as JSONL (``telemetry.rank<R>.jsonl``);
 cross-rank aggregation rides :meth:`CommBackend.allgather_object`;
 ``python -m lddl_tpu.cli telemetry-report`` merges rank files into a
 per-stage summary naming the bottleneck stage.
+
+A sibling event-level layer (:mod:`.trace`, env ``LDDL_TRACE``) records
+*when* things happened into a bounded ring buffer per process
+(``trace.rank<R>[.pid<P>].jsonl``); ``python -m lddl_tpu.cli
+telemetry-trace`` merges all ranks into one clock-aligned
+Chrome-trace-format JSON for Perfetto / ``chrome://tracing``.
 """
 
 from .metrics import (
@@ -29,4 +35,15 @@ from .report import (
     load_rank_files,
     merge_metric_lines,
     render_report,
+)
+from .trace import (
+    NOOP_TRACER,
+    NoopTracer,
+    Tracer,
+    disable_trace,
+    enable_trace,
+    get_tracer,
+    load_trace_files,
+    merge_trace_files,
+    trace_file_name,
 )

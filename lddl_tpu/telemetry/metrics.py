@@ -29,6 +29,7 @@ stream/iterator, so a flip takes effect for everything built after it.
 import json
 import math
 import os
+import tempfile
 import time
 
 
@@ -205,7 +206,10 @@ class Histogram:
     for e in numeric:
       seen += self.buckets[e]
       if seen >= target:
-        return float(2.0 ** (e + 1))
+        # The bucket upper bound can exceed every observed value (a
+        # single 1.1s observation lands in [1, 2) but max is 1.1), so
+        # never report a quantile above the observed max.
+        return min(float(2.0 ** (e + 1)), self.max)
     return self.max
 
   def to_dict(self):
@@ -252,8 +256,11 @@ class Telemetry:
 
   def snapshot_lines(self, rank=0):
     """One JSON-able dict per metric (the JSONL wire format)."""
+    # unix_time and monotonic are sampled together: the pair anchors
+    # this process's monotonic clock on the unix timeline so trace and
+    # metric snapshots from different ranks can be cross-aligned.
     lines = [{'kind': 'meta', 'rank': rank, 'pid': os.getpid(),
-              'unix_time': time.time()}]
+              'unix_time': time.time(), 'monotonic': time.monotonic()}]
     for name in sorted(self._metrics):
       kind, metric = self._metrics[name]
       line = {'kind': kind, 'rank': rank, 'name': name}
@@ -267,8 +274,10 @@ class Telemetry:
         json.dumps(line) for line in self.snapshot_lines(rank)) + '\n'
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
-    tmp = f'{path}.tmp.{os.getpid()}'
-    with open(tmp, 'w') as f:
+    # mkstemp (not a pid-suffixed name): two threads of one process
+    # exporting concurrently must not clobber each other's tmp file.
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + '.tmp.')
+    with os.fdopen(fd, 'w') as f:
       f.write(payload)
     os.replace(tmp, path)
     return path

@@ -243,9 +243,11 @@ class TrainLoop:
 
     from ..loader.device import prefetch_to_device
     from ..telemetry import get_telemetry
+    from ..telemetry.trace import get_tracer
 
     global_batch = self.loader.batch_size * max(jax.process_count(), 1)
     tele = get_telemetry()
+    tracer = get_tracer()
     data_wait_h = tele.histogram('train.data_wait_seconds')
     compute_h = tele.histogram('train.compute_seconds')
     step_h = tele.histogram('train.step_seconds')
@@ -263,13 +265,19 @@ class TrainLoop:
         # pipeline (data wait) is timed separately from the step itself:
         # the split is the report's loader-vs-compute bottleneck signal.
         t_wait = time.perf_counter()
+        tm_wait = time.monotonic() if tracer.enabled else 0.0
         try:
           batch = next(stream)
         except StopIteration:
           break
         t_step = time.perf_counter()
+        tm_step = time.monotonic() if tracer.enabled else 0.0
+        if tracer.enabled:
+          tracer.complete('train.data_wait', tm_wait, tm_step - tm_wait,
+                          args={'step': self.step})
         data_wait_h.observe(t_step - t_wait)
         steps_this_epoch += 1
+        step_no = self.step
         self.params, self.opt_state, metrics = self.step_fn(
             self.params, self.opt_state, self.rng, batch)
         # float() blocks until the device finishes the step, so the
@@ -278,6 +286,13 @@ class TrainLoop:
         losses.append(loss)
         self.step += 1
         self.samples_seen += global_batch
+        if tracer.enabled:
+          tm_now = time.monotonic()
+          tracer.complete('train.compute', tm_step, tm_now - tm_step,
+                          args={'step': step_no})
+          tracer.counter('train.samples_per_sec',
+                         self.loader.batch_size / max(tm_now - tm_wait,
+                                                      1e-9))
         if tele.enabled:
           now = time.perf_counter()
           compute_h.observe(now - t_step)
@@ -337,13 +352,21 @@ def export_telemetry(comm):
   Every rank writes ``telemetry.rank<R>.jsonl`` under
   ``LDDL_TELEMETRY_DIR`` (skipped when unset), then the snapshots are
   merged over the run's own comm backend and rank 0 prints the
-  cross-rank report. No-op (and free) when ``LDDL_TELEMETRY`` is off.
+  cross-rank report. When ``LDDL_TRACE`` is on, the rank's event buffer
+  is exported to ``trace.rank<R>.jsonl`` alongside (merge offline with
+  ``telemetry-trace``). No-op (and free) when both are off.
   """
   from ..telemetry import get_telemetry, rank_file_name
+  from ..telemetry.trace import get_tracer, trace_file_name
   tele = get_telemetry()
+  tracer = get_tracer()
+  out_dir = os.environ.get('LDDL_TELEMETRY_DIR')
+  if tracer.enabled and out_dir:
+    os.makedirs(out_dir, exist_ok=True)
+    tracer.set_identity(rank=comm.rank)
+    tracer.write_jsonl(trace_file_name(out_dir, comm.rank), rank=comm.rank)
   if not tele.enabled:
     return None
-  out_dir = os.environ.get('LDDL_TELEMETRY_DIR')
   if out_dir:
     os.makedirs(out_dir, exist_ok=True)
     tele.write_jsonl(rank_file_name(out_dir, comm.rank), rank=comm.rank)
@@ -434,6 +457,12 @@ def main(args=None):
   from ..tokenization.wordpiece import load_bert_tokenizer
 
   comm = get_backend(args.comm)  # bootstraps jax.distributed under --comm jax
+  from ..telemetry.trace import get_tracer
+  tracer = get_tracer()
+  if tracer.enabled:
+    # Identity up front, so the periodic crash-tail flushes during the
+    # run already land at this rank's canonical trace file.
+    tracer.set_identity(rank=comm.rank)
   tokenizer = load_bert_tokenizer(
       vocab_file=args.vocab_file, hub_name=args.tokenizer, backend='hf')
   vocab = ((tokenizer.vocab_size + 63) // 64) * 64

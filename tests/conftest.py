@@ -22,6 +22,20 @@ jax.config.update('jax_platforms', 'cpu')
 
 import pytest  # noqa: E402
 
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry_registries():
+  """Restore the process-global telemetry and trace registries around
+  every test: a test calling ``telemetry.enable()`` (or flipping
+  ``LDDL_TELEMETRY``/``LDDL_TRACE`` and re-resolving) without disabling
+  must not leak an enabled registry into later tests."""
+  import lddl_tpu.telemetry.metrics as _tm
+  import lddl_tpu.telemetry.trace as _tt
+  old = (_tm._active, _tt._active)
+  yield
+  _tm._active, _tt._active = old
+
+
 WORDS = [
     'alpha', 'bravo', 'charlie', 'delta', 'echo', 'foxtrot', 'golf',
     'hotel', 'india', 'juliet', 'kilo', 'lima', 'mike', 'november',

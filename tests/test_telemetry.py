@@ -17,6 +17,7 @@ import json
 import multiprocessing as mp
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -33,13 +34,8 @@ from test_benchmarks import shards  # noqa: F401  (module-scoped parquet dir)
 
 SMOKE_WORLD = 2
 
-
-@pytest.fixture(autouse=True)
-def _isolate_registry():
-  """Tests flip the process-global registry; always restore it."""
-  old = tm._active
-  yield
-  tm._active = old
+# Registry isolation (restoring tm._active / trace._active between
+# tests) is provided by the autouse fixture in conftest.py.
 
 
 class TestMetricsCore:
@@ -80,6 +76,18 @@ class TestMetricsCore:
     assert h.percentile(0.99) in (1.6, 2.0)
     assert h.percentile(0.2) == 0.0
 
+  def test_percentile_clamped_to_observed_max(self):
+    # Regression: the bucket upper bound 2**(e+1) can exceed every
+    # observed value — a single 1.1s observation must not report
+    # p50=2.0s.
+    t = Telemetry()
+    h = t.histogram('lat')
+    h.observe(1.1)
+    assert h.percentile(0.5) == 1.1
+    assert h.percentile(0.99) == 1.1
+    h.observe(1.9)  # same bucket; bound 2.0 still exceeds max
+    assert h.percentile(0.99) == 1.9
+
   def test_span_times_wall_clock(self):
     t = Telemetry()
     with t.span('phase'):
@@ -103,11 +111,41 @@ class TestMetricsCore:
     with open(path) as f:
       lines = [json.loads(l) for l in f]
     assert lines[0]['kind'] == 'meta' and lines[0]['rank'] == 1
+    # the (unix_time, monotonic) anchor pair for cross-rank alignment
+    assert lines[0]['unix_time'] > 0 and lines[0]['monotonic'] > 0
     by_name = {l['name']: l for l in lines[1:]}
     assert by_name['a'] == {'kind': 'counter', 'rank': 1, 'name': 'a',
                             'total': 3}
     assert by_name['b']['count'] == 1
     assert by_name['c']['value'] == 7.0
+
+  def test_write_jsonl_concurrent_threads(self, tmp_path):
+    # Two in-process exporters must not clobber each other's tmp file
+    # (the suffix was pid-only); every write stays atomic and the final
+    # file always parses.
+    t = Telemetry()
+    t.counter('a').add(1)
+    path = rank_file_name(str(tmp_path), 0)
+    errors = []
+
+    def writer():
+      try:
+        for _ in range(50):
+          t.write_jsonl(path)
+      except Exception as e:  # pragma: no cover - the failure mode
+        errors.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for th in threads:
+      th.start()
+    for th in threads:
+      th.join()
+    assert not errors
+    with open(path) as f:
+      lines = [json.loads(line) for line in f if line.strip()]
+    assert lines[0]['kind'] == 'meta'
+    # no orphaned tmp files left behind
+    assert [p for p in os.listdir(tmp_path) if '.tmp.' in p] == []
 
   def test_env_gating_and_flips(self, monkeypatch):
     monkeypatch.setenv('LDDL_TELEMETRY', '1')
@@ -161,6 +199,41 @@ class TestDisabledFastPath:
     hot(10_000)
     delta = sys.getallocatedblocks() - before
     assert abs(delta) < 20, f'no-op path allocated {delta} blocks'
+
+  def test_trace_handles_are_shared_singletons(self):
+    from lddl_tpu.telemetry.trace import (NOOP_TRACER, disable_trace,
+                                          get_tracer)
+    disable_trace()
+    tracer = get_tracer()
+    assert tracer is NOOP_TRACER and not tracer.enabled
+    assert tracer.span('a') is tracer.span('b')
+    assert tracer.event_dicts() == []
+    assert tracer.write_jsonl('/nonexistent/never-written') is None
+    # structurally allocation-free, like the metrics handles
+    assert type(tracer).__slots__ == ()
+    assert type(tracer.span('a')).__slots__ == ()
+
+  def test_trace_hot_loop_allocates_nothing_per_event(self):
+    """The instrument-site pattern (tracer fetched once, one method call
+    per event, args-dict building guarded by ``tracer.enabled``) must
+    not allocate with tracing off."""
+    from lddl_tpu.telemetry.trace import disable_trace, get_tracer
+    disable_trace()
+    tracer = get_tracer()
+
+    def hot(n):
+      for _ in range(n):
+        tracer.complete('x', 0.0, 1.0)
+        tracer.counter('q', 1)
+        tracer.instant('i')
+        with tracer.span('s'):
+          pass
+
+    hot(100)  # warm method caches
+    before = sys.getallocatedblocks()
+    hot(10_000)
+    delta = sys.getallocatedblocks() - before
+    assert abs(delta) < 20, f'no-op trace path allocated {delta} blocks'
 
 
 def _two_rank_snapshots():
@@ -316,10 +389,12 @@ class TestTrainLoopTelemetry:
     from lddl_tpu.comm import NullBackend
     from lddl_tpu.models import BertConfig
     from lddl_tpu.parallel import make_mesh
+    from lddl_tpu.telemetry.trace import enable_trace, trace_file_name
     from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
     from lddl_tpu.training.pretrain import TrainLoop, export_telemetry
 
     enable()
+    tracer = enable_trace(flush_interval=1e9)
     # CPU has no peak-FLOPs table entry; the env override supplies the
     # MFU denominator (per device, TFLOP/s).
     monkeypatch.setenv('LDDL_PEAK_TFLOPS', '0.5')
@@ -349,8 +424,21 @@ class TestTrainLoopTelemetry:
     assert mfu.count == 3 and 0.0 < mfu.value
     assert tele.gauge('train.samples_per_sec').value > 0
 
+    # the real train loop's trace events, one X span per step phase (the
+    # h2d transfer records on the prefetch producer's own lane)
+    evs = tracer.event_dicts()
+    by_name = {}
+    for ev in evs:
+      by_name.setdefault(ev['name'], []).append(ev)
+    assert len(by_name['train.data_wait']) == 3
+    assert len(by_name['train.compute']) == 3
+    assert [e['args']['step'] for e in by_name['train.compute']] == [0, 1, 2]
+    assert len(by_name['train.h2d']) >= 3
+    assert all(e['ph'] == 'C' for e in by_name['train.samples_per_sec'])
+
     merged = export_telemetry(NullBackend())
     assert os.path.exists(rank_file_name(str(out_dir), 0))
+    assert os.path.exists(trace_file_name(str(out_dir), 0))
     report = capsys.readouterr().out
     assert 'MFU' in report and '[train]' in report
     assert '[bottleneck]' in report
