@@ -1,0 +1,309 @@
+"""Loader feed-path throughput: ``num_workers x transport`` sweep.
+
+Measures batches/s and MB/s of the rank-local feed path under both
+batch transports (``shm`` slot rings vs the classic ``mp.Queue``
+pickling handoff) at every requested worker count, in two modes:
+
+  - ``transport``: workers replay one precollated 64x512 batch
+    (:class:`lddl_tpu.testing.SyntheticBatchLoader`), so the numbers
+    isolate the worker->parent handoff itself — the cost the shm ring
+    removes. This is the apples-to-apples transport comparison.
+  - ``e2e``: the full BERT loader (tokenize-free collate, dynamic
+    masking, committed 30522-entry vocab) over a synthetic balanced
+    shard dir built from that vocab's whole words. End-to-end gains are
+    bounded by collate compute, especially on low-core hosts.
+
+The bench self-attaches telemetry: every cell runs with metrics on,
+exports ``telemetry.rank*.jsonl`` artifacts into a per-cell directory,
+and reports the merged bottleneck verdict
+(:func:`lddl_tpu.telemetry.report.summarize_stages`) alongside its
+throughput line — so a regression report carries its own attribution.
+
+Prints one JSON line per cell and a final summary line with the
+shm-vs-pickle speedup per worker count; commit the output under
+``benchmarks/results/``. Run from the repo root::
+
+  python benchmarks/loader_bench.py --mode both --workers 1,2
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_DEFAULT_VOCAB = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), 'assets',
+    'bench_vocab_30522.txt')
+
+
+def _vocab_words(vocab_file, limit=4000):
+  """Whole lowercase words from the committed vocab (each is exactly one
+  WordPiece token, so on-disk num_tokens is exact)."""
+  words = []
+  with open(vocab_file, encoding='utf-8') as f:
+    for line in f:
+      t = line.strip()
+      if len(t) >= 3 and t.isascii() and t.isalpha() and t.islower():
+        words.append(t)
+        if len(words) >= limit:
+          break
+  if len(words) < 100:
+    raise RuntimeError(f'{vocab_file} has too few whole words')
+  return words
+
+
+def build_shards(dst, vocab_file, num_files=8, samples_per_file=512,
+                 bin_size=512, bin_id=0, seed=7):
+  """Balanced single-bin NSP shards; rows sit in the top 64 tokens of
+  the bin (449-512 for the defaults: every batch pads to ~seq 512)."""
+  import pyarrow as pa
+  import pyarrow.parquet as pq
+  words = _vocab_words(vocab_file)
+  r = random.Random(seed)
+  os.makedirs(dst, exist_ok=True)
+  hi = (bin_id + 1) * bin_size
+  lo = max(bin_id * bin_size + 1, hi - 63, 8)
+  schema = pa.schema([('A', pa.string()), ('B', pa.string()),
+                      ('is_random_next', pa.bool_()),
+                      ('num_tokens', pa.uint16())])
+  for fi in range(num_files):
+    rows = []
+    for _ in range(samples_per_file):
+      nt = r.randrange(lo, hi + 1)
+      na = r.randrange(2, nt - 5)
+      nb = nt - 3 - na
+      rows.append({
+          'A': ' '.join(r.choice(words) for _ in range(na)),
+          'B': ' '.join(r.choice(words) for _ in range(nb)),
+          'is_random_next': bool(r.getrandbits(1)),
+          'num_tokens': nt,
+      })
+    cols = {k: [row[k] for row in rows] for k in schema.names}
+    pq.write_table(pa.table(cols, schema=schema),
+                   os.path.join(dst, f'part.{fi}.parquet_{bin_id}'))
+  return dst
+
+
+def _batch_nbytes(batch):
+  import numpy as np
+  return sum(v.nbytes for v in batch.values() if isinstance(v, np.ndarray))
+
+
+def _drain(make_iter, iters, warmup):
+  """Drain at least ``warmup + iters`` batches in whole epochs (never
+  abandoning an epoch mid-flight, so workers always reach their clean
+  shutdown and export their telemetry); returns (batches/s, MB/s,
+  measured_batches)."""
+  n, nbytes, t0 = 0, 0, None
+  epoch = 0
+  target = iters + warmup
+  while n < target:
+    got_any = False
+    for batch in make_iter(epoch):
+      got_any = True
+      n += 1
+      if n == warmup:
+        t0 = time.perf_counter()
+      elif n > warmup:
+        nbytes += _batch_nbytes(batch)
+    epoch += 1
+    if not got_any or epoch > 100:
+      raise RuntimeError('dataset too small for the requested --iters')
+  if t0 is None:
+    raise RuntimeError(f'--warmup {warmup} never reached ({n} batches)')
+  dt = time.perf_counter() - t0
+  measured = n - warmup
+  return measured / dt, nbytes / dt / 1e6, measured
+
+
+def _run_with_telemetry(tele_dir, fn):
+  """Run ``fn`` with metrics enabled and LDDL_TELEMETRY_DIR pointed at
+  ``tele_dir`` (workers inherit both and export pid-suffixed files),
+  then write the parent snapshot and return (result, merged, verdict)."""
+  from lddl_tpu.telemetry import metrics
+  from lddl_tpu.telemetry.report import (load_rank_files,
+                                         merge_metric_lines,
+                                         summarize_stages)
+  os.makedirs(tele_dir, exist_ok=True)
+  saved = {k: os.environ.get(k)
+           for k in ('LDDL_TELEMETRY', 'LDDL_TELEMETRY_DIR')}
+  os.environ['LDDL_TELEMETRY'] = '1'
+  os.environ['LDDL_TELEMETRY_DIR'] = tele_dir
+  metrics.disable()
+  tele = metrics.enable()
+  try:
+    result = fn()
+    tele.write_jsonl(metrics.rank_file_name(tele_dir, 0))
+  finally:
+    metrics.disable()
+    for k, v in saved.items():
+      if v is None:
+        os.environ.pop(k, None)
+      else:
+        os.environ[k] = v
+  merged = merge_metric_lines(load_rank_files(tele_dir))
+  return result, merged, summarize_stages(merged)
+
+
+def _hist_sum(merged, name):
+  m = merged['metrics'].get(name)
+  return round(m['sum'], 4) if m and m.get('count') else None
+
+
+def _cell(mode, transport, W, make_iter, iters, warmup, tele_root):
+  tele_dir = os.path.join(tele_root, f'{mode}_{transport}_w{W}')
+  (bps, mbps, measured), merged, verdict = _run_with_telemetry(
+      tele_dir, lambda: _drain(make_iter, iters, warmup))
+  cell = {
+      'metric': 'loader_bench_cell',
+      'mode': mode,
+      'transport': transport,
+      'num_workers': W,
+      'batches_per_sec': round(bps, 2),
+      'mb_per_sec': round(mbps, 2),
+      'batches': measured,
+      'pull_stall_total_s': _hist_sum(merged, 'loader.pull_stall_seconds'),
+      'shm_wait_total_s': _hist_sum(merged, 'loader.shm_wait_seconds'),
+      'bottleneck': verdict['bottleneck'],
+      'telemetry_dir': tele_dir,
+  }
+  print(json.dumps(cell), flush=True)
+  return cell
+
+
+def _transport_cells(args, tele_root):
+  from lddl_tpu.loader.shm import default_slot_bytes
+  from lddl_tpu.loader.workers import MultiprocessLoader
+  from lddl_tpu.testing import SyntheticBatchLoader
+  steps = args.iters + args.warmup
+  kwargs = dict(batch_size=args.batch_size, seq_len=args.max_seq_length,
+                steps=steps)
+  cells = [_cell('transport', 'serial', 0,
+                 lambda epoch: iter(SyntheticBatchLoader(**kwargs)),
+                 args.iters, args.warmup, tele_root)]
+  for transport in args.transports:
+    for W in args.workers:
+      def make_iter(epoch, transport=transport, W=W):
+        return iter(MultiprocessLoader(
+            dict(kwargs), W,
+            factory=('lddl_tpu.testing', 'get_synthetic_batch_loader'),
+            transport=transport,
+            slot_bytes=default_slot_bytes(args.batch_size,
+                                          args.max_seq_length)))
+      cells.append(_cell('transport', transport, W, make_iter, args.iters,
+                         args.warmup, tele_root))
+  return cells
+
+
+def _e2e_cells(args, tele_root):
+  from lddl_tpu.comm import NullBackend
+  from lddl_tpu.loader import get_bert_pretrain_data_loader
+  shard_dir = args.shard_dir
+  if shard_dir is None:
+    shard_dir = os.path.join(tele_root, 'shards')
+    build_shards(shard_dir, args.vocab_file, num_files=args.num_files,
+                 samples_per_file=args.samples_per_file,
+                 bin_size=args.bin_size, bin_id=args.bin_id)
+
+  def make_iter(epoch, W=0, transport=None):
+    saved = os.environ.get('LDDL_LOADER_TRANSPORT')
+    if transport:
+      os.environ['LDDL_LOADER_TRANSPORT'] = transport
+    try:
+      loader = get_bert_pretrain_data_loader(
+          shard_dir,
+          vocab_file=args.vocab_file,
+          batch_size_per_rank=args.batch_size,
+          max_seq_length=args.max_seq_length,
+          bin_size=args.bin_size,
+          shuffle_buffer_size=1024,
+          start_epoch=epoch,
+          comm=NullBackend(),
+          num_workers=W,
+      )
+    finally:
+      if transport:
+        if saved is None:
+          os.environ.pop('LDDL_LOADER_TRANSPORT', None)
+        else:
+          os.environ['LDDL_LOADER_TRANSPORT'] = saved
+    return iter(loader)
+
+  cells = [_cell('e2e', 'serial', 0, make_iter, args.e2e_iters,
+                 args.warmup, tele_root)]
+  for transport in args.transports:
+    for W in args.workers:
+      cells.append(_cell(
+          'e2e', transport, W,
+          lambda epoch, W=W, t=transport: make_iter(epoch, W, t),
+          args.e2e_iters, args.warmup, tele_root))
+  return cells
+
+
+def _speedups(cells, mode):
+  """shm-over-pickle batches/s ratio per worker count, one mode."""
+  rates = {(c['transport'], c['num_workers']): c['batches_per_sec']
+           for c in cells if c['mode'] == mode}
+  out = {}
+  for (transport, W), bps in sorted(rates.items()):
+    if transport == 'shm' and ('pickle', W) in rates:
+      out[f'w{W}'] = round(bps / rates[('pickle', W)], 2)
+  return out
+
+
+def main(argv=None):
+  p = argparse.ArgumentParser(description=__doc__)
+  p.add_argument('--mode', choices=('transport', 'e2e', 'both'),
+                 default='both')
+  p.add_argument('--batch-size', type=int, default=64)
+  p.add_argument('--max-seq-length', type=int, default=512)
+  p.add_argument('--iters', type=int, default=200,
+                 help='measured batches per transport-mode cell')
+  p.add_argument('--e2e-iters', type=int, default=48,
+                 help='measured batches per e2e-mode cell')
+  p.add_argument('--warmup', type=int, default=4)
+  p.add_argument('--workers', default='1,2',
+                 help='comma list of worker counts (0 serial baseline '
+                      'always included)')
+  p.add_argument('--transports', default='pickle,shm')
+  p.add_argument('--vocab-file', default=_DEFAULT_VOCAB)
+  p.add_argument('--shard-dir', default=None,
+                 help='reuse an existing balanced shard dir (e2e mode)')
+  p.add_argument('--num-files', type=int, default=8)
+  p.add_argument('--samples-per-file', type=int, default=512)
+  p.add_argument('--bin-size', type=int, default=512)
+  p.add_argument('--bin-id', type=int, default=0)
+  p.add_argument('--telemetry-dir', default=None,
+                 help='where the per-cell telemetry artifacts land '
+                      '(default: a fresh temp dir, path printed)')
+  args = p.parse_args(argv)
+  args.workers = [int(w) for w in str(args.workers).split(',') if w != '']
+  args.transports = [t for t in args.transports.split(',') if t]
+
+  tele_root = args.telemetry_dir or tempfile.mkdtemp(prefix='loader_bench_')
+  cells = []
+  if args.mode in ('transport', 'both'):
+    cells += _transport_cells(args, tele_root)
+  if args.mode in ('e2e', 'both'):
+    cells += _e2e_cells(args, tele_root)
+
+  summary = {
+      'metric': 'loader_bench_summary',
+      'batch_size': args.batch_size,
+      'max_seq_length': args.max_seq_length,
+      'shm_speedup': {m: _speedups(cells, m)
+                      for m in ('transport', 'e2e')
+                      if any(c['mode'] == m for c in cells)},
+      'telemetry_dir': tele_root,
+  }
+  print(json.dumps(summary), flush=True)
+  return {'cells': cells, 'summary': summary}
+
+
+if __name__ == '__main__':
+  main()

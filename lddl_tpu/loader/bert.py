@@ -36,6 +36,7 @@ from ..core.utils import (get_all_bin_ids, get_all_parquets_under,
 from ..telemetry import get_telemetry
 from ..telemetry.trace import get_tracer
 from .binned import BinnedIterator
+from .columnar import gather_numeric, gather_token_counts
 from .dataset import ParquetShardDataset
 
 IGNORE_INDEX = -100
@@ -110,10 +111,17 @@ class BertCollate:
 
     # Segment lengths without per-row splits: segments are single-space
     # joined by the preprocess writer, so token count = space count + 1.
+    # Columnar rows get the counts from one Arrow kernel per block
+    # (gather_* return None on plain-dict rows — the fallback keeps the
+    # collate usable standalone and byte-identical either way).
     a_strs = [row['A'] for row in rows]
     b_strs = [row['B'] for row in rows]
-    na = np.fromiter((s.count(' ') + 1 for s in a_strs), np.int64, count=n)
-    nb = np.fromiter((s.count(' ') + 1 for s in b_strs), np.int64, count=n)
+    na = gather_token_counts(rows, 'A')
+    if na is None:
+      na = np.fromiter((s.count(' ') + 1 for s in a_strs), np.int64, count=n)
+    nb = gather_token_counts(rows, 'B')
+    if nb is None:
+      nb = np.fromiter((s.count(' ') + 1 for s in b_strs), np.int64, count=n)
     # One conversion for the whole batch's tokens (single join + split).
     flat_ids = np.asarray(
         self._tok.convert_tokens_to_ids(' '.join(a_strs + b_strs).split()),
@@ -149,8 +157,10 @@ class BertCollate:
     attention_mask = (cols < total[:, None]).astype(np.int32)
     token_type_ids = ((cols >= (2 + na)[:, None]) &
                       (cols < total[:, None])).astype(np.int32)
-    nsp = np.fromiter((row['is_random_next'] for row in rows),
-                      np.int32, count=n)
+    nsp = gather_numeric(rows, 'is_random_next', np.int32)
+    if nsp is None:
+      nsp = np.fromiter((row['is_random_next'] for row in rows),
+                        np.int32, count=n)
 
     labels = np.full((n, seq_len), IGNORE_INDEX, dtype=np.int32)
     if self._masking == 'static':
@@ -162,9 +172,11 @@ class BertCollate:
                            count=n)
       # Validate per row (not in aggregate: offsetting mismatches across
       # rows would silently cross-assign labels between rows).
-      label_counts = np.fromiter(
-          (row['masked_lm_labels'].count(' ') + 1 for row in rows),
-          np.int64, count=n)
+      label_counts = gather_token_counts(rows, 'masked_lm_labels')
+      if label_counts is None:
+        label_counts = np.fromiter(
+            (row['masked_lm_labels'].count(' ') + 1 for row in rows),
+            np.int64, count=n)
       if not np.array_equal(label_counts, counts):
         bad = int(np.nonzero(label_counts != counts)[0][0])
         raise AssertionError(
@@ -438,6 +450,9 @@ def get_bert_pretrain_data_loader(
     log_level=None,
     return_raw_samples=False,
     num_workers=0,
+    transport=None,
+    queue_depth=None,
+    zero_copy=None,
 ):
   """Build the BERT pretraining loader over a balanced shard directory.
 
@@ -453,17 +468,29 @@ def get_bert_pretrain_data_loader(
   ``torch/bert.py:382-386``); output batches are byte-identical to
   ``num_workers=0`` — see :mod:`lddl_tpu.loader.workers`. Requires
   ``vocab_file``/``tokenizer_name`` (not a live ``tokenizer``).
+  ``transport``/``queue_depth``/``zero_copy``: batch-handoff knobs for
+  the worker path, each defaulting from its ``LDDL_LOADER_*`` env var
+  (``MultiprocessLoader`` docs); ignored when ``num_workers=0``.
   """
   if num_workers:
     # locals() here holds exactly this function's parameters (this block
     # is the first statement), so a future parameter cannot be silently
     # dropped from the worker rebuild — that would break the documented
-    # byte-identity between num_workers=0 and >0.
-    build_kwargs = {k: v for k, v in locals().items() if k != 'num_workers'}
+    # byte-identity between num_workers=0 and >0. Transport knobs shape
+    # the handoff, not the batches, so they stay out of the rebuild.
+    _transport_knobs = ('num_workers', 'transport', 'queue_depth',
+                        'zero_copy')
+    build_kwargs = {
+        k: v for k, v in locals().items()
+        if k not in _transport_knobs and k != '_transport_knobs'
+    }
     from .workers import MultiprocessLoader
-    return MultiprocessLoader(build_kwargs, num_workers)
+    return MultiprocessLoader(build_kwargs, num_workers,
+                              transport=transport, queue_depth=queue_depth,
+                              zero_copy=zero_copy)
   if return_raw_samples:
-    collate = lambda rows, seq_len, epoch, step: rows
+    from .columnar import materialize_rows
+    collate = lambda rows, seq_len, epoch, step: materialize_rows(rows)
     return build_pretrain_loader(
         path, collate, dp_rank=dp_rank, dp_world_size=dp_world_size,
         batch_size_per_rank=batch_size_per_rank,

@@ -27,6 +27,12 @@ from ..core.random import choices, get_state
 class BinnedIterator:
   """Iterates (bin_id, list_of_rows) batches for one epoch.
 
+  Rows are whatever the datasets stream — columnar
+  :class:`~lddl_tpu.loader.columnar.RowView` handles in the normal path —
+  and pass through untouched: bin draws depend only on remaining batch
+  counts, never on row contents, so the handle/dict distinction cannot
+  perturb the cross-rank bin agreement or the delivered sample order.
+
   ``datasets``: list of :class:`ParquetShardDataset`, one per bin (a
   single-element list for unbinned data). Each bin contributes
   ``samples_per_rank_per_epoch // samples_per_batch_per_rank`` full

@@ -1,0 +1,174 @@
+"""Columnar row handles: the zero-copy row stream currency.
+
+The row stream used to eagerly convert every Arrow record batch to
+Python (``to_pylist()`` per column) and yield one dict per row. That
+work is replayed by *every* loader worker (step sharding replays the
+full deterministic stream in each process, :mod:`.workers`), and it
+converts columns nobody reads (``num_tokens`` always; the static-mask
+columns in dynamic-masking mode).
+
+Instead the stream yields :class:`RowView` handles — ``(block,
+row_idx)`` pairs over a shared :class:`ColumnarBlock` that wraps the
+decoded Arrow record batch as-is. Field access materializes lazily,
+once per column per block, and cached conversions are shared by every
+row of the block. The shuffle buffer shuffles the handles exactly as it
+shuffled dicts (its randomization is position-dependent, never
+value-dependent), so the delivered sample order — and therefore the
+documented byte-identity across ``num_workers`` — is unchanged.
+
+Collates keep working untouched (``row['A']`` hits the lazy cache), and
+get an optional columnar fast path: :func:`gather_token_counts` /
+:func:`gather_numeric` compute per-row values from whole-column Arrow
+kernels instead of per-row Python string ops.
+"""
+
+import numpy as np
+
+
+class ColumnarBlock:
+  """A decoded Arrow record batch with per-column lazy conversion caches.
+
+  One instance is shared by all :class:`RowView` handles over the batch;
+  it stays alive (holding the Arrow buffers) for as long as any of its
+  rows sit in a shuffle buffer or a pending collate.
+  """
+
+  __slots__ = ('_batch', '_index', '_pylists', '_npcols', '_tokcounts')
+
+  def __init__(self, record_batch):
+    self._batch = record_batch
+    self._index = {n: i for i, n in enumerate(record_batch.schema.names)}
+    self._pylists = {}
+    self._npcols = {}
+    self._tokcounts = {}
+
+  @property
+  def num_rows(self):
+    return self._batch.num_rows
+
+  @property
+  def names(self):
+    return self._batch.schema.names
+
+  def pylist(self, name):
+    """The column as a Python list (converted once, then cached)."""
+    col = self._pylists.get(name)
+    if col is None:
+      col = self._batch.column(self._index[name]).to_pylist()
+      self._pylists[name] = col
+    return col
+
+  def npcol(self, name):
+    """The column as a numpy array (fixed-width types; cached)."""
+    arr = self._npcols.get(name)
+    if arr is None:
+      arr = self._batch.column(self._index[name]).to_numpy(
+          zero_copy_only=False)
+      self._npcols[name] = arr
+    return arr
+
+  def token_counts(self, name):
+    """Per-row token counts of a single-space-joined string column.
+
+    ``count + 1`` of the space separators, computed in one Arrow
+    ``count_substring`` kernel over the whole column — the columnar
+    replacement for per-row ``s.count(' ') + 1``.
+    """
+    arr = self._tokcounts.get(name)
+    if arr is None:
+      import pyarrow.compute as pc
+      counts = pc.count_substring(self._batch.column(self._index[name]), ' ')
+      arr = counts.to_numpy(zero_copy_only=False).astype(np.int64) + 1
+      self._tokcounts[name] = arr
+    return arr
+
+
+class RowView:
+  """A lightweight ``(block, row)`` handle with dict-style field access.
+
+  Drop-in for the per-row dicts the stream used to yield: supports
+  ``row[name]``, ``in``, iteration over field names, ``items()`` etc.
+  Pickling materializes to a plain dict (worker fallbacks and
+  ``return_raw_samples`` consumers see ordinary dicts on the far side).
+  """
+
+  __slots__ = ('block', 'idx')
+
+  def __init__(self, block, idx):
+    self.block = block
+    self.idx = idx
+
+  def __getitem__(self, name):
+    try:
+      return self.block.pylist(name)[self.idx]
+    except KeyError:
+      raise KeyError(name) from None
+
+  def get(self, name, default=None):
+    if name in self.block._index:
+      return self.block.pylist(name)[self.idx]
+    return default
+
+  def keys(self):
+    return list(self.block.names)
+
+  def __contains__(self, name):
+    return name in self.block._index
+
+  def __iter__(self):
+    return iter(self.block.names)
+
+  def __len__(self):
+    return len(self.block.names)
+
+  def items(self):
+    return [(n, self[n]) for n in self.block.names]
+
+  def values(self):
+    return [self[n] for n in self.block.names]
+
+  def to_dict(self):
+    return {n: self[n] for n in self.block.names}
+
+  def __eq__(self, other):
+    if isinstance(other, RowView):
+      return self.block is other.block and self.idx == other.idx
+    if isinstance(other, dict):
+      return self.to_dict() == other
+    return NotImplemented
+
+  def __repr__(self):
+    return f'RowView({self.to_dict()!r})'
+
+  def __reduce__(self):
+    # Pickle as a plain dict: handles crossing a process boundary (the
+    # oversize-batch fallback, raw-samples worker mode) must not drag
+    # the whole Arrow block along.
+    return (dict, (self.to_dict(),))
+
+
+def materialize_rows(rows):
+  """Plain dicts for raw-samples consumers (no-op on dict rows): the
+  ``return_raw_samples`` debug contract is ordinary dicts, not handles."""
+  return [r.to_dict() if type(r) is RowView else r for r in rows]
+
+
+def gather_token_counts(rows, name):
+  """Per-row token counts for a single-space-joined string column, via
+  the block-level Arrow kernel; ``None`` when any row is not a
+  :class:`RowView` (caller falls back to per-row string ops)."""
+  n = len(rows)
+  if not all(type(r) is RowView for r in rows):
+    return None
+  return np.fromiter((r.block.token_counts(name)[r.idx] for r in rows),
+                     np.int64, count=n)
+
+
+def gather_numeric(rows, name, dtype):
+  """Per-row values of a fixed-width column as ``dtype``, via the cached
+  block-level numpy conversion; ``None`` on non-RowView rows."""
+  n = len(rows)
+  if not all(type(r) is RowView for r in rows):
+    return None
+  return np.fromiter((r.block.npcol(name)[r.idx] for r in rows),
+                     dtype, count=n)

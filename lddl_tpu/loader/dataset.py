@@ -19,9 +19,13 @@ Capability parity: reference ``lddl/torch/datasets.py:112-286`` (torch) and
   - mid-epoch resume: skip whole files / slice the first record batch by a
     ``samples_to_skip`` count (reference ``torch_mp/datasets.py:87-98``).
 
-TPU-first delta: rows are decoded from Arrow record batches column-wise
-with zero Python-per-field work deferred to collate time; the dataset
-yields plain dicts and the collate layer owns array building.
+TPU-first delta: rows stay columnar end to end. The stream yields
+:class:`~lddl_tpu.loader.columnar.RowView` handles over the decoded
+Arrow record batches instead of per-row dicts; field conversion is
+deferred to collate time and happens once per column per block (see
+:mod:`lddl_tpu.loader.columnar`). The shuffle-buffer randomization is
+position-dependent, so swapping dicts for handles leaves the delivered
+sample order byte-identical.
 """
 
 import os
@@ -34,6 +38,7 @@ from ..core.random import rng_from_key
 from ..core.utils import count_parquet_samples_strided
 from ..telemetry import get_telemetry
 from ..telemetry.trace import get_tracer
+from .columnar import ColumnarBlock, RowView
 from .shuffle_buffer import ShuffleBuffer
 
 
@@ -156,22 +161,25 @@ class ParquetShardDataset:
     for fi, path in enumerate(files):
       if fi < skip_files:
         continue
-      pf = pq.ParquetFile(path)
-      remaining = self._samples_per_file
-      to_skip = skip_rows if fi == skip_files else 0
-      for batch in pf.iter_batches():
-        if remaining <= 0:
-          break
-        take = min(batch.num_rows, remaining)
-        remaining -= take
-        if to_skip >= take:
-          to_skip -= take
-          continue
-        with decode_h.time(), tracer.span('loader.read_batch'):
-          cols = {name: batch.column(i).to_pylist()
-                  for i, name in enumerate(batch.schema.names)}
-        n = take
-        for r in range(to_skip, n):
-          rows_c.add(1)
-          yield {name: col[r] for name, col in cols.items()}
-        to_skip = 0
+      with pq.ParquetFile(path) as pf:
+        remaining = self._samples_per_file
+        to_skip = skip_rows if fi == skip_files else 0
+        batches = pf.iter_batches()
+        while remaining > 0:
+          with decode_h.time(), tracer.span('loader.read_batch'):
+            batch = next(batches, None)
+          if batch is None:
+            break
+          take = min(batch.num_rows, remaining)
+          remaining -= take
+          if to_skip >= take:
+            to_skip -= take
+            continue
+          # Columnar handoff: no per-row dicts, no eager to_pylist — the
+          # block converts a column at most once, on first collate-time
+          # access (RowView.__getitem__ / the gather_* fast paths).
+          block = ColumnarBlock(batch)
+          start, to_skip = to_skip, 0
+          rows_c.add(take - start)
+          for r in range(start, take):
+            yield RowView(block, r)

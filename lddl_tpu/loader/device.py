@@ -56,6 +56,11 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
   transferred element-wise). With ``mesh=None`` batches are placed whole
   on the default device. ``data_axis``/``seq_axis`` forward to
   :func:`make_global_batch`.
+
+  This consumption pattern satisfies the loader's ``zero_copy=True``
+  contract (:mod:`.workers`): the producer thread transfers each batch
+  to device *before* pulling the next one from ``iterator``, so a
+  shared-memory view is always consumed while its slot is still held.
   """
 
   def _put(item):

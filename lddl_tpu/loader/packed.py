@@ -143,8 +143,10 @@ def get_packed_pretrain_data_loader(
       samples_seen=samples_seen, comm=comm, log_dir=log_dir,
       log_level=log_level)
   if return_raw_samples:
+    from .columnar import materialize_rows
     return build_pretrain_loader(
-        path, lambda rows, seq_len, epoch, step: rows, **common)
+        path, lambda rows, seq_len, epoch, step: materialize_rows(rows),
+        **common)
   if tokenizer is None:
     from ..tokenization.wordpiece import load_bert_tokenizer
     tokenizer = load_bert_tokenizer(

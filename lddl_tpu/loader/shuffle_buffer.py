@@ -9,6 +9,16 @@ random resident one. The final drain is shuffled.
 Determinism: all randomness comes from the caller-provided
 ``random.Random`` instance, so a given (seed, stream order) always yields
 the same shuffled stream — the property resumable training rests on.
+
+The buffer is value-agnostic: every random draw depends only on stream
+*position*, never on sample contents. That is what lets the row stream
+swap per-row dicts for columnar :class:`~lddl_tpu.loader.columnar.RowView`
+handles without moving a single sample in the delivered order (the
+byte-identity guarantee in :mod:`~lddl_tpu.loader.workers` rests on it).
+Note the resident set holds up to ``size`` handles, each keeping its
+Arrow block alive — blocks are shared per record batch, so worst-case
+buffered memory is bounded by ~``size`` rows + their blocks, same order
+as the dict regime it replaced.
 """
 
 

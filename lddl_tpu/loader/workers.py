@@ -13,16 +13,35 @@ changes).
 Because step ownership is static, the parent needs no reorder buffer:
 step ``s`` always arrives on worker ``s % W``'s own queue, so pulling the
 queues round-robin yields the exact serial order with per-worker
-backpressure (each worker can run at most ``queue maxsize`` steps ahead —
+backpressure (each worker can run at most ``queue_depth`` steps ahead —
 bounded memory by construction).
 
 The expensive work (the collate: ragged scatter, id conversion, mask
 drawing) parallelizes across W processes; the replayed bookkeeping
-(shuffle-buffer row stream) is duplicated per worker but is an order of
-magnitude cheaper than collate.
+(shuffle-buffer row stream) is duplicated per worker but — now that the
+stream passes columnar handles, :mod:`.columnar` — costs an order of
+magnitude less than collate.
+
+Batch transport (``transport=`` / ``LDDL_LOADER_TRANSPORT``):
+
+  - ``'shm'`` (default): workers write each batch's arrays into a
+    preallocated shared-memory slot ring (:mod:`.shm`) and the queue
+    carries only ``(slot, spec)`` descriptors; ring occupancy is the
+    backpressure. The parent copies arrays out of the slot by default;
+    with ``zero_copy=True`` (or ``LDDL_LOADER_ZERO_COPY=1``) it yields
+    views into the slot instead — valid until the *next* batch from the
+    same worker is pulled (W steps of grace), which a device-feeding
+    consumer like ``prefetch_to_device`` always satisfies, but
+    ``list(loader)`` does not.
+  - ``'pickle'``: the classic ``mp.Queue`` handoff (full pickle + pipe
+    crossing per batch) — kept for comparison and exotic batch payloads.
+
+Both transports deliver byte-identical batches; a batch that does not
+fit its shm slot silently falls back to pickling for that step.
 """
 
 import multiprocessing as _mp
+import os
 import queue as _queue
 import sys
 import time
@@ -30,6 +49,15 @@ import traceback
 
 from ..telemetry import get_telemetry
 from ..telemetry.trace import get_tracer
+from .shm import BatchRing, SlotOverflow, default_slot_bytes
+
+_TRANSPORT_ENV = 'LDDL_LOADER_TRANSPORT'
+_DEPTH_ENV = 'LDDL_LOADER_QUEUE_DEPTH'
+_ZERO_COPY_ENV = 'LDDL_LOADER_ZERO_COPY'
+# The queue-depth gauge reads qsize() on every worker queue — O(W)
+# advisory syscalls — so it is sampled once per this many pulls instead
+# of every step.
+_DEPTH_SAMPLE_EVERY = 32
 
 
 def _mp_context():
@@ -43,6 +71,30 @@ def _mp_context():
   return _mp.get_context()
 
 
+def _resolve_transport(transport):
+  t = (transport or os.environ.get(_TRANSPORT_ENV, '').strip().lower()
+       or 'shm')
+  if t not in ('shm', 'pickle'):
+    raise ValueError(f'unknown loader transport {t!r} (shm|pickle)')
+  return t
+
+
+def _resolve_queue_depth(queue_depth):
+  if queue_depth is None:
+    queue_depth = int(os.environ.get(_DEPTH_ENV, '').strip() or 4)
+  queue_depth = int(queue_depth)
+  if queue_depth < 1:
+    raise ValueError(f'queue_depth must be >= 1, got {queue_depth}')
+  return queue_depth
+
+
+def _resolve_zero_copy(zero_copy):
+  if zero_copy is None:
+    spec = os.environ.get(_ZERO_COPY_ENV, '').strip().lower()
+    zero_copy = spec in ('1', 'true', 'on', 'yes')
+  return bool(zero_copy)
+
+
 DEFAULT_FACTORY = ('lddl_tpu.loader.bert', 'get_bert_pretrain_data_loader')
 
 
@@ -52,31 +104,78 @@ def _resolve_factory(factory):
   return getattr(importlib.import_module(module), attr)
 
 
+def _export_worker_telemetry(tele, rank):
+  """Write this worker's metric snapshot beside the rank's (pid-suffixed,
+  so the report CLI's ``telemetry.rank*.jsonl`` glob merges it): without
+  this, worker-side series like ``loader.shm_wait_seconds`` would die
+  with the process."""
+  out_dir = os.environ.get('LDDL_TELEMETRY_DIR')
+  if not (out_dir and tele.enabled):
+    return
+  try:
+    tele.write_jsonl(
+        os.path.join(out_dir, f'telemetry.rank{rank}.pid{os.getpid()}.jsonl'),
+        rank=rank)
+  except OSError:
+    pass  # export is advisory; never kill a worker over it
+
+
 def _worker_main(build_kwargs, factory, epoch, clear_consumed, w,
-                 num_workers, q):
+                 num_workers, q, free_q, ring_desc):
+  tele = get_telemetry()
   tracer = get_tracer()
+  rank = int(build_kwargs.get('dp_rank') or 0)
   if tracer.enabled:
     # Fresh buffer under this worker's own identity: a forked child
     # inherits the parent's event buffer, and each worker must flush to
     # its own trace.rank<R>.pid<P>.jsonl file.
-    tracer.reset(rank=int(build_kwargs.get('dp_rank') or 0), per_pid=True)
+    tracer.reset(rank=rank, per_pid=True)
+  ring = None
   try:
+    if ring_desc is not None:
+      ring = BatchRing.attach(*ring_desc)
+    wait_h = tele.histogram('loader.shm_wait_seconds')
     loader = _resolve_factory(factory)(**build_kwargs)
     loader.epoch = epoch
     if clear_consumed:
       loader._batches_consumed = 0
     for step, batch in loader.iter_steps((w, num_workers)):
-      q.put(('batch', step, batch))
+      if ring is None:
+        q.put(('batch', step, batch))
+        continue
+      t0 = time.monotonic()
+      slot = free_q.get()
+      wait_h.observe(time.monotonic() - t0)
+      if tracer.enabled:
+        try:  # advisory, like the parent's depth gauge
+          free = free_q.qsize()
+        except NotImplementedError:
+          free = None
+        if free is not None:
+          tracer.counter(f'loader.shm_slot_occupancy.w{w}',
+                         ring.num_slots - free)
+      try:
+        spec = ring.pack(slot, batch)
+      except SlotOverflow:
+        # The slot was never published; recycle it and pickle this batch.
+        free_q.put(slot)
+        q.put(('batch', step, batch))
+        continue
+      q.put(('slot', step, (slot, spec)))
     # Flush before signalling 'done': the parent may terminate() this
     # process the moment it sees the sentinel, which would race a
     # flush placed after it.
     tracer.flush()
+    _export_worker_telemetry(tele, rank)
     q.put(('done', w, None))
   except BaseException:
     q.put(('error', w, traceback.format_exc()))
     raise
   finally:
     tracer.flush()  # crash/error path still leaves a tail
+    _export_worker_telemetry(tele, rank)
+    if ring is not None:
+      ring.close()
 
 
 class MultiprocessLoader:
@@ -87,9 +186,16 @@ class MultiprocessLoader:
   (so pass ``vocab_file``/``tokenizer_name``, not a live tokenizer
   object). The serial loader built in-process serves metadata
   (``__len__``, ``samples_per_epoch``) and tracks epoch/resume state.
+
+  ``transport``/``queue_depth``/``zero_copy``/``slot_bytes`` tune the
+  batch handoff (see the module docstring); each defaults from its
+  ``LDDL_LOADER_*`` environment knob so deployments can flip them
+  without touching call sites.
   """
 
-  def __init__(self, build_kwargs, num_workers, factory=DEFAULT_FACTORY):
+  def __init__(self, build_kwargs, num_workers, factory=DEFAULT_FACTORY,
+               transport=None, queue_depth=None, zero_copy=None,
+               slot_bytes=None):
     from ..comm import NullBackend
     if build_kwargs.get('tokenizer') is not None:
       raise ValueError(
@@ -106,7 +212,16 @@ class MultiprocessLoader:
     # metadata needs no collective, and a cache miss just counts locally.
     self._kwargs['comm'] = NullBackend()
     self._num_workers = num_workers
+    self._transport = _resolve_transport(transport)
+    self._queue_depth = _resolve_queue_depth(queue_depth)
+    self._zero_copy = _resolve_zero_copy(zero_copy)
     self._serial = _resolve_factory(self._factory)(**build_kwargs)
+    if slot_bytes is None:
+      slot_bytes = default_slot_bytes(
+          build_kwargs.get('batch_size_per_rank')
+          or getattr(self._serial, 'batch_size', None) or 64,
+          build_kwargs.get('max_seq_length') or 512)
+    self._slot_bytes = int(slot_bytes)
 
   def __len__(self):
     return len(self._serial)
@@ -118,6 +233,14 @@ class MultiprocessLoader:
   @property
   def batch_size(self):
     return self._serial.batch_size
+
+  @property
+  def transport(self):
+    return self._transport
+
+  @property
+  def queue_depth(self):
+    return self._queue_depth
 
   @property
   def epoch(self):
@@ -156,35 +279,65 @@ class MultiprocessLoader:
     tracer = get_tracer()
     stall_h = tele.histogram('loader.pull_stall_seconds')
     depth_g = tele.gauge('loader.queue_depth')
+    W = self._num_workers
+    depth = self._queue_depth
     ctx = _mp_context()
-    queues = [ctx.Queue(maxsize=4) for _ in range(self._num_workers)]
+    queues = [ctx.Queue(maxsize=depth) for _ in range(W)]
+    rings, free_qs, ring_descs = [], [None] * W, [None] * W
+    if self._transport == 'shm':
+      rings = [BatchRing(depth, self._slot_bytes) for _ in range(W)]
+      free_qs = [ctx.Queue(maxsize=depth) for _ in range(W)]
+      for fq in free_qs:
+        for s in range(depth):
+          fq.put(s)
+      ring_descs = [(r.name, depth, self._slot_bytes) for r in rings]
     procs = [
         ctx.Process(
             target=_worker_main,
             args=(self._kwargs, self._factory, epoch, clear_consumed, w,
-                  self._num_workers, queues[w]),
-            daemon=True) for w in range(self._num_workers)
+                  W, queues[w], free_qs[w], ring_descs[w]),
+            daemon=True) for w in range(W)
     ]
-    for p in procs:
-      p.start()
-    step = first_step
     try:
+      for p in procs:
+        p.start()
+      step = first_step
+      pulls = 0
+      held = [None] * W  # zero-copy mode: last yielded slot per worker
       while True:
-        w = step % self._num_workers
-        if tele.enabled or tracer.enabled:
+        w = step % W
+        if (tele.enabled or tracer.enabled) and \
+            pulls % _DEPTH_SAMPLE_EVERY == 0:
           try:  # qsize is advisory (and absent on some platforms)
-            depth = sum(q.qsize() for q in queues)
+            qdepth = sum(q.qsize() for q in queues)
           except NotImplementedError:
-            depth = None
-          if depth is not None:
-            depth_g.set(depth)
-            tracer.counter('loader.queue_depth', depth)
+            qdepth = None
+          if qdepth is not None:
+            depth_g.set(qdepth)
+            tracer.counter('loader.queue_depth', qdepth)
+        pulls += 1
         t_pull = time.monotonic() if tracer.enabled else 0.0
         kind, a, b = self._get(queues[w], procs[w], w, stall_h)
         if tracer.enabled:
           tracer.complete('loader.pull', t_pull, time.monotonic() - t_pull,
                           args={'worker': w, 'step': step})
-        if kind == 'batch':
+        if kind == 'slot':
+          assert a == step, f'worker {w} sent step {a}, expected {step}'
+          slot, spec = b
+          if self._zero_copy:
+            # Views stay valid until this worker's slot supply recycles;
+            # release the previous one only now that the consumer asked
+            # for a later batch.
+            if held[w] is not None:
+              free_qs[w].put(held[w])
+            batch = rings[w].unpack(spec, copy=False)
+            held[w] = slot
+          else:
+            batch = rings[w].unpack(spec, copy=True)
+            free_qs[w].put(slot)
+          yield batch
+          step += 1
+        elif kind == 'batch':
           assert a == step, f'worker {w} sent step {a}, expected {step}'
           yield b
           step += 1
@@ -201,3 +354,7 @@ class MultiprocessLoader:
           p.terminate()
       for p in procs:
         p.join(timeout=30)
+      # Unlink after the workers are gone: the parent owns every segment
+      # name, so even a SIGKILLed worker cannot leak one.
+      for r in rings:
+        r.destroy()

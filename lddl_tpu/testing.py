@@ -115,6 +115,73 @@ def expected_min_truncated_rows(balanced_dir):
   return expected
 
 
+class SyntheticBatchLoader:
+  """A loader-protocol stand-in that replays one precollated batch.
+
+  Implements exactly the surface :class:`~lddl_tpu.loader.workers.
+  MultiprocessLoader` drives (``iter_steps``, ``epoch``,
+  ``_batches_consumed``, ``__len__``, ``samples_per_epoch``,
+  ``batch_size``) with a near-zero production cost, so transport
+  microbenchmarks and tests measure the worker→parent handoff itself
+  rather than collate throughput.
+  """
+
+  def __init__(self, batch_size=64, seq_len=512, steps=256, comm=None,
+               **_ignored):
+    import numpy as np
+    self._steps = int(steps)
+    self._batch_size = int(batch_size)
+    rng = np.random.Generator(np.random.Philox(key=[7, 9]))
+    shape = (int(batch_size), int(seq_len))
+    self._batch = {
+        'input_ids': rng.integers(0, 30000, shape).astype(np.int32),
+        'token_type_ids': np.zeros(shape, np.int32),
+        'attention_mask': np.ones(shape, np.int32),
+        'labels': np.full(shape, -100, np.int32),
+        'next_sentence_labels': np.zeros(int(batch_size), np.int32),
+    }
+    self.epoch = 0
+    self._batches_consumed = 0
+
+  def __len__(self):
+    return self._steps - self._batches_consumed
+
+  @property
+  def batch_size(self):
+    return self._batch_size
+
+  @property
+  def samples_per_epoch(self):
+    return self._steps * self._batch_size
+
+  def iter_steps(self, step_shard=(0, 1)):
+    import numpy as np
+    w, num_shards = step_shard
+    first = self._batches_consumed
+    self._batches_consumed = 0
+    for step in range(first, self._steps):
+      if step % num_shards != w:
+        continue
+      # Stamp the step into the batch so byte-identity checks catch
+      # reordering / slot-recycling bugs, not just transport liveness.
+      batch = dict(self._batch)
+      ids = batch['input_ids'].copy()
+      ids[:, 0] = np.int32(step)
+      batch['input_ids'] = ids
+      yield step, batch
+    self.epoch += 1
+
+  def __iter__(self):
+    for _, batch in self.iter_steps():
+      yield batch
+
+
+def get_synthetic_batch_loader(**kwargs):
+  """Factory entry point for worker processes (importable by module
+  path, the :data:`~lddl_tpu.loader.workers.DEFAULT_FACTORY` shape)."""
+  return SyntheticBatchLoader(**kwargs)
+
+
 def check_dp_drains(balanced_dir, world, bin_size, base_seed,
                     drained_keys=None, with_positions=True):
   """Assert the dp ranks' drains are pairwise disjoint, cover exactly the

@@ -24,6 +24,18 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _no_leaked_shm_segments():
+  """Fail any test that leaves an ``lddl_`` shared-memory segment behind:
+  the loader's shm batch transport must unlink its slot rings on clean
+  shutdown, consumer abandonment, and worker SIGKILL alike."""
+  from lddl_tpu.loader.shm import live_segments
+  before = set(live_segments())
+  yield
+  leaked = sorted(set(live_segments()) - before)
+  assert not leaked, f'leaked shared-memory segments: {leaked}'
+
+
+@pytest.fixture(autouse=True)
 def _reset_telemetry_registries():
   """Restore the process-global telemetry and trace registries around
   every test: a test calling ``telemetry.enable()`` (or flipping

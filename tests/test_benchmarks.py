@@ -140,6 +140,52 @@ def test_bart_loader_bench_smoke(tiny_vocab, tmp_path, capsys):
   assert payload['batches'] == 4 and payload['value'] > 0
 
 
+def test_loader_bench_smoke(tmp_path, capsys):
+  """loader_bench sweeps num_workers x transport in both modes, prints
+  one JSON line per cell + a summary with shm-vs-pickle speedups, and
+  self-attaches per-cell telemetry artifacts."""
+  import glob
+  bench = _load('loader_bench')
+  result = bench.main([
+      '--mode', 'both', '--batch-size', '4', '--max-seq-length', '64',
+      '--iters', '6', '--e2e-iters', '4', '--warmup', '1',
+      '--workers', '1', '--bin-size', '64', '--bin-id', '0',
+      '--num-files', '2', '--samples-per-file', '16',
+      '--telemetry-dir', str(tmp_path / 'tele'),
+  ])
+  cells = result['cells']
+  assert {c['mode'] for c in cells} == {'transport', 'e2e'}
+  for mode in ('transport', 'e2e'):
+    assert {c['transport'] for c in cells
+            if c['mode'] == mode and c['num_workers'] == 1} \
+        == {'pickle', 'shm'}
+  for c in cells:
+    assert c['batches_per_sec'] > 0 and c['mb_per_sec'] > 0
+    assert glob.glob(
+        os.path.join(c['telemetry_dir'], 'telemetry.rank*.jsonl'))
+  assert 'w1' in result['summary']['shm_speedup']['transport']
+  lines = capsys.readouterr().out.strip().splitlines()
+  assert json.loads(lines[-1])['metric'] == 'loader_bench_summary'
+
+
+def test_loader_bench_committed_artifact_meets_speedup_floor():
+  """The committed sweep artifact must demonstrate the shm transport's
+  reason to exist: >= 1.5x batches/s over the pickling queue for
+  num_workers >= 2 at batch 64 x seq 512 (transport-isolated mode)."""
+  path = os.path.join(_ROOT, 'benchmarks', 'results',
+                      'loader_transport_sweep.txt')
+  summary = None
+  with open(path) as f:
+    for line in f:
+      if line.startswith('{'):
+        payload = json.loads(line)
+        if payload.get('metric') == 'loader_bench_summary':
+          summary = payload
+  assert summary is not None
+  assert summary['batch_size'] == 64 and summary['max_seq_length'] == 512
+  assert summary['shm_speedup']['transport']['w2'] >= 1.5
+
+
 def test_real_text_corpus_harvest(tmp_path):
   """real_text_bench's harvester yields real prose documents in the
   one-doc-per-line source format with markup stripped."""

@@ -7,12 +7,37 @@ epochs and on a mid-epoch resume.
 """
 
 import numpy as np
+import pytest
 
 from lddl_tpu.loader import get_bert_pretrain_data_loader
 
-from test_loader import binned_shards  # noqa: F401  (fixture reuse)
+from conftest import make_nsp_sample
+from test_loader import _schema, binned_shards  # noqa: F401  (fixture reuse)
 
 BIN_SIZE = 64
+
+
+@pytest.fixture()
+def masked_shards(tmp_path):
+  """binned_shards with stored mask columns (static-masking mode)."""
+  import random
+
+  import pyarrow as pa
+  import pyarrow.parquet as pq
+  d = tmp_path / 'masked_shards'
+  d.mkdir()
+  r = random.Random(7)
+  schema = _schema(True)
+  for b in range(2):
+    for f in range(4):
+      rows = [make_nsp_sample(r, b, BIN_SIZE, with_mask=True)
+              for _ in range(8)]
+      cols = {
+          k: pa.array([row[k] for row in rows], type=schema.field(k).type)
+          for k in schema.names
+      }
+      pq.write_table(pa.table(cols), str(d / f'shard-{f}.parquet_{b}'))
+  return str(d)
 
 
 def _collect(loader, epochs=1):
@@ -65,6 +90,42 @@ def test_workers_match_serial_on_resume(binned_shards, tiny_vocab):  # noqa: F81
                    num_workers=3)
   assert len(parallel) == len(serial) == per_epoch - seen_batches
   _assert_same(_collect(serial), _collect(parallel))
+
+
+@pytest.mark.parametrize('masking', ('dynamic', 'static'))
+@pytest.mark.parametrize('W', (1, 3))
+def test_shm_transport_byte_identity(request, tiny_vocab, masking, W):
+  """The shm slot-ring transport must deliver the serial loader's exact
+  bytes for every worker count, in both masking modes."""
+  shards = request.getfixturevalue(
+      'binned_shards' if masking == 'dynamic' else 'masked_shards')
+  serial = _make(shards, tiny_vocab, masking=masking)
+  parallel = _make(shards, tiny_vocab, masking=masking, num_workers=W,
+                   transport='shm')
+  assert parallel.transport == 'shm'
+  got = _collect(serial)
+  assert got[0], 'fixture must yield batches (vacuous pass otherwise)'
+  _assert_same(got, _collect(parallel))
+
+
+def test_pickle_transport_still_byte_identical(binned_shards, tiny_vocab):  # noqa: F811
+  serial = _make(binned_shards, tiny_vocab)
+  parallel = _make(binned_shards, tiny_vocab, num_workers=2,
+                   transport='pickle')
+  assert parallel.transport == 'pickle'
+  _assert_same(_collect(serial), _collect(parallel))
+
+
+def test_zero_copy_views_match_when_consumed_in_order(binned_shards,  # noqa: F811
+                                                      tiny_vocab):
+  """zero_copy=True yields views into the shm slot that are valid until
+  the next pull from the same worker; an immediately-consuming reader
+  (the prefetch_to_device pattern) sees the exact serial bytes."""
+  serial = _make(binned_shards, tiny_vocab)
+  parallel = _make(binned_shards, tiny_vocab, num_workers=2,
+                   zero_copy=True)
+  snapshots = [[{k: v.copy() for k, v in b.items()} for b in parallel]]
+  _assert_same(_collect(serial), snapshots)
 
 
 def test_workers_reject_live_tokenizer(binned_shards, tiny_vocab):  # noqa: F811

@@ -362,7 +362,11 @@ class TestInstrumentedLayers:
     stall = tele.histogram('loader.pull_stall_seconds')
     # one pull per delivered batch, plus the terminating 'done' pull(s)
     assert n_batches > 0 and stall.count >= n_batches
-    assert tele.gauge('loader.queue_depth').count >= n_batches
+    # the advisory qsize() gauge is sampled every N pulls, not per step
+    # (workers.py _DEPTH_SAMPLE_EVERY), so it records at least once per
+    # epoch but far fewer times than there are batches
+    depth = tele.gauge('loader.queue_depth')
+    assert 1 <= depth.count <= n_batches
 
   def test_file_backend_collective_metrics(self, tmp_path):
     from lddl_tpu.comm import FileBackend

@@ -363,7 +363,8 @@ def test_worker_main_flushes_per_pid_trace_file(binned_shards, tiny_vocab,  # no
       path=binned_shards, vocab_file=tiny_vocab, batch_size_per_rank=4,
       bin_size=BIN_SIZE, max_seq_length=2 * BIN_SIZE, base_seed=31,
       dp_rank=1, dp_world_size=2)
-  _worker_main(build_kwargs, DEFAULT_FACTORY, 0, True, 0, 1, q)
+  # free_q/ring_desc None: the in-process drive uses the pickle path.
+  _worker_main(build_kwargs, DEFAULT_FACTORY, 0, True, 0, 1, q, None, None)
   assert q.items[-1][0] == 'done'
   path = trace_file_name(str(tmp_path), 1, pid=os.getpid())
   assert os.path.exists(path)
