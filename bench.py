@@ -77,6 +77,27 @@ def _telemetry_artifacts():
   return extra
 
 
+def _lint_status():
+  """Stamp the analyzer's verdict onto the BENCH JSON line.
+
+  Perf artifacts assume the determinism invariants lddl-analyze guards
+  (LDA001/LDA002: identical plans and seeded randomness — see PERF.md);
+  recording clean/dirty makes every captured number traceable to a
+  lint-clean tree. Never fails the bench: an import/analysis error just
+  omits the fields.
+  """
+  try:
+    from lddl_tpu.analysis import analyze_package
+    unsuppressed, suppressed = analyze_package()
+    return {
+        'lint_clean': not unsuppressed,
+        'lint_findings': len(unsuppressed),
+        'lint_suppressed': len(suppressed),
+    }
+  except Exception:
+    return {}
+
+
 def _reference_style_partition(lines, hf_tok, vocab_words, seed,
                                duplicate_factor=5):
   """The reference's per-partition hot loop, reimplemented faithfully:
@@ -202,6 +223,7 @@ def main():
         'dup1_mb_per_sec_per_chip': round(dup1_mbps, 3),
     }
     result.update(_telemetry_artifacts())
+    result.update(_lint_status())
     print(json.dumps(result))
   finally:
     shutil.rmtree(work, ignore_errors=True)

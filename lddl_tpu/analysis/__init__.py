@@ -1,0 +1,54 @@
+"""Static analysis for the pipeline's SPMD determinism and
+resource-safety invariants (the ``lddl-analyze`` linter).
+
+The correctness story of this codebase rests on properties no runtime
+test can fully cover: every rank derives the identical sample plan
+without communication, all randomness flows through seeded helpers,
+collectives are issued uniformly, and a killed worker leaks nothing.
+This package turns those conventions into an AST-based check that runs
+in tier-1 (``tests/test_analysis_self.py``), so refactors cannot
+silently erode them.
+
+Layout:
+  - :mod:`.engine`: parse + single ancestor-tracking walk, import-alias
+    resolution, pragma suppression;
+  - :mod:`.rules`: the LDA001-LDA005 ruleset;
+  - :mod:`.findings`: the finding model (file:line, rule id, fix hint);
+  - :mod:`.pragmas`: inline ``# lddl: noqa[LDAxxx]`` suppressions;
+  - :mod:`.cli`: the ``lddl-analyze`` console entry point.
+"""
+
+import os
+
+from .engine import (
+    Rule,
+    analyze_file,
+    analyze_paths,
+    analyze_source,
+)
+from .findings import Finding
+from .rules import default_rules, rules_by_id
+
+
+def analyze_package(rules=None):
+  """Run the linter over the installed ``lddl_tpu`` tree itself.
+
+  Returns ``(unsuppressed, suppressed)`` finding lists — the self-check
+  test and ``bench.py``'s lint-status stamp both go through here.
+  """
+  root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+  findings, _ = analyze_paths([root], rules=rules)
+  return ([f for f in findings if not f.suppressed],
+          [f for f in findings if f.suppressed])
+
+
+__all__ = [
+    'Finding',
+    'Rule',
+    'analyze_file',
+    'analyze_package',
+    'analyze_paths',
+    'analyze_source',
+    'default_rules',
+    'rules_by_id',
+]

@@ -1,0 +1,150 @@
+"""``lddl-analyze``: the SPMD determinism & resource-safety linter.
+
+Usage::
+
+  lddl-analyze [paths...]              # default: lddl_tpu/ if it exists
+  lddl-analyze --json lddl_tpu/        # machine-readable findings
+  lddl-analyze --rule LDA001,LDA004 .  # subset of rules
+  lddl-analyze --changed               # only files changed vs HEAD
+  lddl-analyze --changed --diff-base main~3
+  lddl-analyze --list-rules
+
+Exit status: 0 when every finding is pragma-suppressed (or none exist),
+1 when unsuppressed findings remain, 2 on usage errors. The tier-1
+self-check (``tests/test_analysis_self.py``) asserts exit-0 over
+``lddl_tpu/`` itself, making the linter a standing gate for every PR.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from .engine import analyze_file, discover_py_files
+from .rules import default_rules, rules_by_id
+
+JSON_SCHEMA_VERSION = 1
+
+
+def _git_changed_files(diff_base):
+  """Absolute paths of files changed vs ``diff_base`` plus untracked
+  files, per git; raises on any git failure (a broken filter silently
+  scanning nothing would report a falsely clean tree)."""
+  top = subprocess.run(
+      ['git', 'rev-parse', '--show-toplevel'],
+      capture_output=True, text=True, check=True).stdout.strip()
+  changed = subprocess.run(
+      ['git', 'diff', '--name-only', '-z', diff_base, '--'],
+      capture_output=True, text=True, check=True, cwd=top).stdout
+  untracked = subprocess.run(
+      ['git', 'ls-files', '--others', '--exclude-standard', '-z'],
+      capture_output=True, text=True, check=True, cwd=top).stdout
+  names = [n for n in (changed + untracked).split('\0') if n]
+  return {os.path.abspath(os.path.join(top, n)) for n in names}
+
+
+def build_parser():
+  parser = argparse.ArgumentParser(
+      prog='lddl-analyze',
+      description='SPMD determinism & resource-safety linter for the '
+      'lddl_tpu pipeline')
+  parser.add_argument('paths', nargs='*',
+                      help='files or directories to analyze '
+                      '(default: ./lddl_tpu when present, else .)')
+  parser.add_argument('--json', action='store_true', dest='as_json',
+                      help='emit one JSON object instead of text')
+  parser.add_argument('--rule', default=None,
+                      help='comma-separated rule ids to run '
+                      '(e.g. LDA001,LDA004); default: all')
+  parser.add_argument('--changed', action='store_true',
+                      help='only analyze files git reports as changed '
+                      'or untracked (fast local runs)')
+  parser.add_argument('--diff-base', default='HEAD',
+                      help='git ref --changed diffs against '
+                      '(default: HEAD)')
+  parser.add_argument('--show-suppressed', action='store_true',
+                      help='also print pragma-suppressed findings in '
+                      'text mode')
+  parser.add_argument('--list-rules', action='store_true',
+                      help='print the rule table and exit')
+  return parser
+
+
+def _select_rules(spec):
+  if not spec:
+    return default_rules(), None
+  by_id = rules_by_id()
+  wanted = [r.strip().upper() for r in spec.split(',') if r.strip()]
+  unknown = [r for r in wanted if r not in by_id]
+  if unknown:
+    return None, f'unknown rule id(s): {", ".join(unknown)} ' \
+                 f'(known: {", ".join(sorted(by_id))})'
+  return [by_id[r] for r in wanted], None
+
+
+def main(args=None):
+  opts = build_parser().parse_args(args)
+  if opts.list_rules:
+    for rule in default_rules():
+      print(f'{rule.rule_id}  {rule.name}')
+      print(f'    protects: {rule.invariant}')
+      print(f'    fix: {rule.hint}')
+    return 0
+
+  rules, err = _select_rules(opts.rule)
+  if err:
+    print(f'lddl-analyze: {err}', file=sys.stderr)
+    return 2
+
+  paths = opts.paths
+  if not paths:
+    paths = ['lddl_tpu'] if os.path.isdir('lddl_tpu') else ['.']
+  missing = [p for p in paths if not os.path.exists(p)]
+  if missing:
+    print(f'lddl-analyze: no such path: {", ".join(missing)}',
+          file=sys.stderr)
+    return 2
+
+  file_filter = None
+  if opts.changed:
+    try:
+      file_filter = _git_changed_files(opts.diff_base)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+      print(f'lddl-analyze: --changed requires a git checkout ({e})',
+            file=sys.stderr)
+      return 2
+
+  files = discover_py_files(paths)
+  if file_filter is not None:
+    files = [f for f in files if os.path.abspath(f) in file_filter]
+  findings = []
+  for f in files:
+    findings.extend(analyze_file(f, rules=rules))
+
+  unsuppressed = [f for f in findings if not f.suppressed]
+  suppressed = [f for f in findings if f.suppressed]
+
+  if opts.as_json:
+    print(json.dumps({
+        'version': JSON_SCHEMA_VERSION,
+        'files_scanned': len(files),
+        'findings': [f.as_dict() for f in findings],
+        'num_findings': len(unsuppressed),
+        'num_suppressed': len(suppressed),
+        'clean': not unsuppressed,
+    }))
+    return 0 if not unsuppressed else 1
+
+  shown = findings if opts.show_suppressed else unsuppressed
+  for f in shown:
+    print(f.render())
+  state = 'clean' if not unsuppressed else 'DIRTY'
+  print(f'lddl-analyze: {len(files)} files, '
+        f'{len(unsuppressed)} finding(s), '
+        f'{len(suppressed)} suppressed — {state}')
+  return 0 if not unsuppressed else 1
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -1,0 +1,227 @@
+"""AST visitor engine: one parse + one ancestor-tracking walk per file,
+with every registered rule riding the same traversal.
+
+The engine owns the cross-cutting machinery rules should not reimplement:
+
+  - import-alias resolution, so ``import numpy as np; np.random.rand()``
+    and ``from random import shuffle; shuffle(x)`` both resolve to their
+    canonical dotted names (``numpy.random.rand`` / ``random.shuffle``) —
+    including relative imports, which keep their leading dots so
+    ``from ..core import random as lrandom`` can never be mistaken for
+    the stdlib ``random`` module;
+  - ancestor chains (``ctx.ancestors``), so rules can ask "is this call
+    wrapped in ``sorted(...)``?" or "is this node under a ``with`` item?"
+    without bookkeeping of their own;
+  - pragma-based suppression and deterministic finding order.
+
+Rules subclass :class:`Rule`: ``begin_module`` runs once per file (for
+scope/taint pre-passes), ``on_node`` runs for every AST node.
+"""
+
+import ast
+import os
+
+from .findings import Finding, sort_findings
+from .pragmas import is_suppressed, pragma_lines
+
+
+class Rule:
+  """Base class for one ``LDAxxx`` check."""
+
+  rule_id = ''
+  name = ''
+  # One line: the pipeline invariant this rule protects (docs + --list-rules).
+  invariant = ''
+  hint = ''
+
+  def exempt(self, ctx):
+    """Whether this rule is off for ``ctx.path`` (e.g. LDA002 inside the
+    seeded-RNG module itself). Default: applies everywhere."""
+    return False
+
+  def begin_module(self, ctx):
+    """Per-file pre-pass; may yield findings."""
+    return ()
+
+  def on_node(self, node, ctx):
+    """Per-node check; may yield findings."""
+    return ()
+
+  def finding(self, node, message, ctx, hint=None):
+    return Finding(
+        rule_id=self.rule_id,
+        path=ctx.path,
+        line=getattr(node, 'lineno', 1),
+        col=getattr(node, 'col_offset', 0) + 1,
+        message=message,
+        hint=self.hint if hint is None else hint,
+        end_line=getattr(node, 'end_lineno', 0) or 0,
+    )
+
+
+class ModuleContext:
+  """Everything rules may want to know about the file being analyzed."""
+
+  def __init__(self, tree, path, source):
+    self.tree = tree
+    self.path = path
+    self.source = source
+    # Normalized forward-slash path for rule exemption matching.
+    self.norm_path = os.path.abspath(path).replace(os.sep, '/')
+    self.aliases = _import_aliases(tree)
+    self.ancestors = ()  # set by the walker before each on_node dispatch
+
+  def path_is(self, *fragments):
+    """Whether the file lives under any of the given path fragments
+    (``'telemetry/'``, ``'core/random.py'``, ...)."""
+    return any(f'/{frag}' in self.norm_path or
+               self.norm_path.endswith(f'/{frag.rstrip("/")}')
+               for frag in fragments)
+
+  def basename(self):
+    return os.path.basename(self.norm_path)
+
+  def qualname(self, node):
+    """Canonical dotted name of an attribute/name chain, resolved through
+    this module's import aliases; None when the chain does not bottom out
+    in a plain name (e.g. a call result: ``Path(p).glob(...)``)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+      parts.append(node.attr)
+      node = node.value
+    if not isinstance(node, ast.Name):
+      return None
+    parts.append(self.aliases.get(node.id, node.id))
+    return '.'.join(reversed(parts))
+
+  def call_name(self, call):
+    """(dotted, terminal) for a Call: the resolved dotted name (or None)
+    and the last attribute/name segment (always available)."""
+    dotted = self.qualname(call.func)
+    if isinstance(call.func, ast.Attribute):
+      return dotted, call.func.attr
+    if isinstance(call.func, ast.Name):
+      return dotted, call.func.id
+    return dotted, ''
+
+  def enclosing(self, *types):
+    """Nearest ancestor of the given AST types (innermost first)."""
+    for node in reversed(self.ancestors):
+      if isinstance(node, types):
+        return node
+    return None
+
+
+def _import_aliases(tree):
+  """local name -> canonical dotted origin, from every import statement.
+
+  ``import numpy as np`` -> ``np: numpy``; ``import a.b`` -> ``a: a``;
+  ``from x.y import z as w`` -> ``w: x.y.z``; relative imports keep
+  their dots (``from ..core import random`` -> ``random: ..core.random``)
+  so they can never collide with an absolute stdlib name.
+  """
+  aliases = {}
+  for node in ast.walk(tree):
+    if isinstance(node, ast.Import):
+      for a in node.names:
+        if a.asname:
+          aliases[a.asname] = a.name
+        else:
+          root = a.name.split('.')[0]
+          aliases[root] = root
+    elif isinstance(node, ast.ImportFrom):
+      base = '.' * node.level + (node.module or '')
+      for a in node.names:
+        if a.name == '*':
+          continue
+        sep = '' if base.endswith('.') or not base else '.'
+        aliases[a.asname or a.name] = f'{base}{sep}{a.name}'
+  return aliases
+
+
+def walk_with_ancestors(tree):
+  """Yield ``(node, ancestors)`` for every node; ancestors are outermost
+  first and exclude the node itself."""
+  stack = [(tree, ())]
+  while stack:
+    node, anc = stack.pop()
+    yield node, anc
+    child_anc = anc + (node,)
+    for child in ast.iter_child_nodes(node):
+      stack.append((child, child_anc))
+
+
+def analyze_source(source, path='<string>', rules=None):
+  """Run ``rules`` over one module's source. Returns all findings (the
+  pragma-suppressed ones flagged, not dropped), sorted by location.
+
+  A file that does not parse yields a single ``LDA000`` finding — a
+  syntactically broken module can't have its invariants checked, which
+  is itself a finding, not a crash.
+  """
+  if rules is None:
+    from .rules import default_rules
+    rules = default_rules()
+  try:
+    tree = ast.parse(source, filename=path)
+  except (SyntaxError, ValueError) as e:
+    line = getattr(e, 'lineno', 1) or 1
+    return [
+        Finding(rule_id='LDA000', path=path, line=line, col=1,
+                message=f'file does not parse: {e.msg or e}',
+                hint='fix the syntax error so the file can be analyzed')
+    ]
+  ctx = ModuleContext(tree, path, source)
+  findings = []
+  applicable = [r for r in rules if not r.exempt(ctx)]
+  for rule in applicable:
+    findings.extend(rule.begin_module(ctx))
+  node_rules = [r for r in applicable
+                if type(r).on_node is not Rule.on_node]
+  if node_rules:
+    for node, ancestors in walk_with_ancestors(tree):
+      ctx.ancestors = ancestors
+      for rule in node_rules:
+        findings.extend(rule.on_node(node, ctx))
+  pragmas = pragma_lines(source)
+  if pragmas:
+    for f in findings:
+      f.suppressed = is_suppressed(f, pragmas)
+  return sort_findings(findings)
+
+
+def analyze_file(path, rules=None):
+  with open(path, encoding='utf-8') as f:
+    source = f.read()
+  return analyze_source(source, path=path, rules=rules)
+
+
+def discover_py_files(paths):
+  """Expand files/directories into a sorted, deduplicated ``.py`` list
+  (sorted: the analyzer's own output order must be rank-stable too)."""
+  out = []
+  for p in paths:
+    if os.path.isdir(p):
+      # lddl: noqa[LDA001] the aggregate list is sorted(set(...)) below
+      # before anything consumes it, so walk order cannot leak out.
+      out.extend(
+          os.path.join(r, f)
+          for r, _, files in os.walk(p)
+          for f in files
+          if f.endswith('.py'))
+    elif p.endswith('.py'):
+      out.append(p)
+  return sorted(set(out))
+
+
+def analyze_paths(paths, rules=None):
+  """Analyze every ``.py`` file under ``paths`` (files or directories).
+
+  Returns ``(findings, files_scanned)``; findings include suppressed
+  ones (callers filter on ``f.suppressed``).
+  """
+  files = discover_py_files(paths)
+  findings = []
+  for path in files:
+    findings.extend(analyze_file(path, rules=rules))
+  return findings, len(files)

@@ -1,0 +1,58 @@
+"""The finding model shared by the engine, rules, and CLI.
+
+A :class:`Finding` is one rule violation at one source location. Findings
+carry a stable ``rule_id`` (``LDAxxx``), a human message describing the
+hazard, and a ``hint`` describing the idiomatic fix, so both the text and
+``--json`` renderings are self-explanatory. ``suppressed`` marks findings
+covered by an inline ``# lddl: noqa[LDAxxx]`` pragma — they are reported
+(in ``--json`` and with ``--show-suppressed``) but never fail the run.
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class Finding:
+  """One rule violation at ``path:line:col``."""
+
+  rule_id: str
+  path: str
+  line: int
+  col: int
+  message: str
+  hint: str = ''
+  end_line: int = 0  # last source line of the flagged node (pragma window)
+  suppressed: bool = False
+
+  def __post_init__(self):
+    if not self.end_line:
+      self.end_line = self.line
+
+  def location(self):
+    return f'{self.path}:{self.line}:{self.col}'
+
+  def as_dict(self):
+    """JSON-stable rendering (the ``--json`` schema, one entry per
+    finding): rule, path, line, col, message, hint, suppressed."""
+    return {
+        'rule': self.rule_id,
+        'path': self.path,
+        'line': self.line,
+        'col': self.col,
+        'message': self.message,
+        'hint': self.hint,
+        'suppressed': self.suppressed,
+    }
+
+  def render(self):
+    tag = ' (suppressed)' if self.suppressed else ''
+    out = f'{self.location()}: {self.rule_id}{tag}: {self.message}'
+    if self.hint:
+      out += f'\n    hint: {self.hint}'
+    return out
+
+
+def sort_findings(findings):
+  """Deterministic report order: path, then line/col, then rule id."""
+  return sorted(findings,
+                key=lambda f: (f.path, f.line, f.col, f.rule_id))

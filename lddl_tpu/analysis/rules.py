@@ -1,0 +1,348 @@
+"""The initial ruleset: the SPMD-determinism and resource-safety
+invariants this pipeline actually depends on.
+
+Every rank must derive the identical sample plan without communication
+(LDA001/LDA002/LDA003), collectives must be issued uniformly by all ranks
+(LDA005), and a killed worker must never leak file handles or shared
+memory (LDA004). Each rule documents the invariant it protects in
+``invariant`` — that text is what ``--list-rules`` and the README table
+show.
+"""
+
+import ast
+
+from .engine import Rule
+
+# ---------------------------------------------------------------------------
+# LDA001: unsorted filesystem iteration
+
+
+_FS_OS = frozenset({'os.listdir', 'os.scandir', 'os.walk'})
+_FS_GLOB = frozenset({'glob.glob', 'glob.iglob'})
+_FS_PATH_METHODS = frozenset({'iterdir', 'rglob'})
+
+
+class UnsortedFsIteration(Rule):
+  rule_id = 'LDA001'
+  name = 'unsorted-fs-iteration'
+  invariant = ('every rank derives the identical file plan: directory '
+               'listing order is filesystem-dependent, so unsorted '
+               'iteration can diverge across hosts')
+  hint = 'wrap the call in sorted(...) before consuming its order'
+
+  def on_node(self, node, ctx):
+    if not isinstance(node, ast.Call):
+      return
+    dotted, term = ctx.call_name(node)
+    hazard = None
+    if dotted in _FS_OS or dotted in _FS_GLOB:
+      hazard = dotted
+    elif term in _FS_PATH_METHODS and isinstance(node.func, ast.Attribute):
+      hazard = f'.{term}'
+    elif (term == 'glob' and isinstance(node.func, ast.Attribute) and
+          dotted not in _FS_GLOB and
+          (dotted is None or not dotted.startswith('glob.'))):
+      # Path(...).glob(...) / some_path.glob(...): same order hazard.
+      hazard = '.glob'
+    if hazard is None:
+      return
+    for anc in ctx.ancestors:
+      if (isinstance(anc, ast.Call) and
+          isinstance(anc.func, ast.Name) and anc.func.id == 'sorted'):
+        return
+    yield self.finding(
+        node,
+        f'{hazard}() consumed without sorted(): filesystem iteration '
+        'order is not deterministic across hosts, so ranks can derive '
+        'divergent plans', ctx)
+
+
+# ---------------------------------------------------------------------------
+# LDA002: process-global / unseeded RNG
+
+
+_NP_BIT_GENERATORS = frozenset({
+    'Generator', 'Philox', 'PCG64', 'PCG64DXSM', 'MT19937', 'SFC64',
+    'SeedSequence', 'BitGenerator',
+})
+_NP_SEED_REQUIRED = frozenset({'default_rng', 'RandomState'})
+
+
+class GlobalStateRng(Rule):
+  rule_id = 'LDA002'
+  name = 'global-state-rng'
+  invariant = ('all randomness flows through seeded Philox / '
+               'core.random helpers: global-state RNG draws depend on '
+               'call order and imports, not on the run seed')
+  hint = ('use lddl_tpu.core.random helpers or a seeded '
+          'np.random.Generator(Philox(...)) / random.Random(seed)')
+
+  def exempt(self, ctx):
+    # The seeded-RNG module itself wraps the global state (under a state
+    # swap), and test/benchmark scaffolding may use ad-hoc randomness.
+    if ctx.path_is('core/random.py', 'tests/'):
+      return True
+    base = ctx.basename()
+    return (base.startswith('test_') or
+            base in ('conftest.py', 'testing.py'))
+
+  def on_node(self, node, ctx):
+    if not isinstance(node, ast.Call):
+      return
+    dotted, _ = ctx.call_name(node)
+    if not dotted:
+      return
+    seeded = bool(node.args or node.keywords)
+    if dotted.split('.')[0] == 'random' and dotted.count('.') == 1:
+      fn = dotted.split('.')[1]
+      if fn == 'Random':
+        if not seeded:
+          yield self.finding(
+              node, 'random.Random() without a seed falls back to OS '
+              'entropy: draws differ per rank and per run', ctx)
+        return
+      if fn == 'SystemRandom':
+        yield self.finding(
+            node, 'random.SystemRandom draws OS entropy: '
+            'non-reproducible by design', ctx)
+        return
+      yield self.finding(
+          node, f'random.{fn}() uses the process-global RNG: draws '
+          'depend on import/call order, not on the run seed', ctx)
+      return
+    if dotted.startswith('numpy.random.'):
+      fn = dotted[len('numpy.random.'):].split('.')[0]
+      if fn in _NP_BIT_GENERATORS:
+        return
+      if fn in _NP_SEED_REQUIRED:
+        if not seeded:
+          yield self.finding(
+              node, f'np.random.{fn}() without a seed draws OS entropy: '
+              'every rank gets a different stream', ctx)
+        return
+      yield self.finding(
+          node, f'np.random.{fn}() uses numpy\'s process-global RNG: '
+          'draws depend on call order, not on the run seed', ctx)
+
+
+# ---------------------------------------------------------------------------
+# LDA003: wall-clock in control flow
+
+
+_CLOCKS = frozenset({
+    'time.time', 'time.time_ns', 'time.monotonic', 'time.monotonic_ns',
+})
+
+
+def _clock_call(node, ctx):
+  """The first wall-clock call anywhere under ``node``, or None."""
+  for n in ast.walk(node):
+    if isinstance(n, ast.Call) and ctx.call_name(n)[0] in _CLOCKS:
+      return ctx.call_name(n)[0]
+  return None
+
+
+def _scope_nodes(root):
+  """All nodes of one scope, without descending into nested functions
+  (those are their own taint scopes)."""
+  stack = list(ast.iter_child_nodes(root))
+  while stack:
+    n = stack.pop()
+    yield n
+    if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+      stack.extend(ast.iter_child_nodes(n))
+
+
+def _assigned_names(target):
+  """Plain names bound by an assignment target. Attribute/subscript
+  targets (``self.t0 = time.monotonic()``) are object state, outside
+  this rule's one-level name taint — tainting ``self`` for them would
+  flag every later ``if self...`` branch."""
+  if isinstance(target, ast.Name):
+    yield target.id
+  elif isinstance(target, (ast.Tuple, ast.List)):
+    for elt in target.elts:
+      yield from _assigned_names(elt)
+  elif isinstance(target, ast.Starred):
+    yield from _assigned_names(target.value)
+
+
+class WallClockControlFlow(Rule):
+  rule_id = 'LDA003'
+  name = 'wall-clock-control-flow'
+  invariant = ('control flow is a function of logical progress, not '
+               'wall-clock: ranks observing different times take '
+               'different branches and diverge or deadlock')
+  hint = ('branch on step/sample counts instead; timing that only feeds '
+          'metrics belongs in telemetry/')
+
+  def exempt(self, ctx):
+    # Telemetry is *about* time; its comparisons never steer the pipeline.
+    return ctx.path_is('telemetry/')
+
+  def begin_module(self, ctx):
+    scopes = [ctx.tree]
+    scopes.extend(
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    for scope in scopes:
+      nodes = list(_scope_nodes(scope))
+      tainted = set()
+      for n in nodes:
+        value = getattr(n, 'value', None)
+        if value is None:
+          continue
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                          ast.NamedExpr)) and _clock_call(value, ctx):
+          targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+          for t in targets:
+            tainted.update(_assigned_names(t))
+      for n in nodes:
+        if not isinstance(n, (ast.If, ast.While, ast.IfExp)):
+          continue
+        clock = _clock_call(n.test, ctx)
+        if clock:
+          yield self.finding(
+              n.test, f'{clock}() feeds this branch condition: ranks '
+              'observing different clocks diverge', ctx)
+          continue
+        used = sorted(
+            x.id for x in ast.walk(n.test)
+            if isinstance(x, ast.Name) and x.id in tainted)
+        if used:
+          yield self.finding(
+              n.test, f'{used[0]!r} (derived from a wall-clock read) '
+              'feeds this branch condition: ranks observing different '
+              'clocks diverge', ctx)
+
+
+# ---------------------------------------------------------------------------
+# LDA004: resource acquisition without scoped release
+
+
+_OPENERS = frozenset({'open', 'io.open', 'os.fdopen'})
+_RELEASE_ATTRS = frozenset({
+    'close', 'destroy', 'unlink', 'terminate', 'release', 'shutdown',
+    'cleanup', '__exit__',
+})
+
+
+def _finally_releases(try_node):
+  for stmt in try_node.finalbody:
+    for n in ast.walk(stmt):
+      if not isinstance(n, ast.Call):
+        continue
+      if (isinstance(n.func, ast.Attribute) and
+          n.func.attr in _RELEASE_ATTRS):
+        return True
+      if isinstance(n.func, ast.Name) and 'close' in n.func.id:
+        return True
+  return False
+
+
+class UnscopedResource(Rule):
+  rule_id = 'LDA004'
+  name = 'unscoped-resource'
+  invariant = ('a crashed or killed worker never leaks handles or '
+               '/dev/shm segments: every acquisition is released by a '
+               'with block or try/finally')
+  hint = ('acquire under "with", or inside a try whose finally '
+          'closes/unlinks the resource')
+
+  def on_node(self, node, ctx):
+    if not isinstance(node, ast.Call):
+      return
+    dotted, term = ctx.call_name(node)
+    what = None
+    if dotted in _OPENERS:
+      what = f'{dotted}()'
+    elif term == 'ParquetFile' and dotted != 'ParquetFile':
+      what = 'pq.ParquetFile()'
+    elif term == 'SharedMemory':
+      what = 'shared_memory.SharedMemory()'
+    if what is None:
+      return
+    for anc in reversed(ctx.ancestors):
+      if isinstance(anc, ast.withitem):
+        return  # the context expression of a with block
+      if isinstance(anc, ast.Call):
+        _, anc_term = ctx.call_name(anc)
+        if anc_term in ('closing', 'enter_context'):
+          return  # ExitStack / contextlib ownership
+      if isinstance(anc, ast.Try) and _finally_releases(anc):
+        return
+    yield self.finding(
+        node, f'{what} acquired without a scoped release: a crash '
+        'before the close leaks the handle (the ParquetFile/shm leak '
+        'class)', ctx)
+
+
+# ---------------------------------------------------------------------------
+# LDA005: collective inside a rank-conditional branch
+
+
+_COLLECTIVES = frozenset({
+    'allgather_object', 'allreduce_sum', 'broadcast_object', 'barrier',
+    'allreduce', 'allgather', 'broadcast', 'reduce_scatter', 'all_to_all',
+    'sync_global_devices', 'process_allgather',
+})
+_RANK_IDENTS = frozenset({
+    'process_index', 'process_id', 'is_primary', 'is_coordinator',
+    'is_main_process',
+})
+
+
+def _rank_mention(test):
+  for n in ast.walk(test):
+    ident = None
+    if isinstance(n, ast.Name):
+      ident = n.id
+    elif isinstance(n, ast.Attribute):
+      ident = n.attr
+    if ident and ('rank' in ident.lower() or ident in _RANK_IDENTS):
+      return ident
+  return None
+
+
+class RankConditionalCollective(Rule):
+  rule_id = 'LDA005'
+  name = 'rank-conditional-collective'
+  invariant = ('collectives are issued uniformly by every rank: a '
+               'collective some ranks skip deadlocks the ones that '
+               'entered it (the classic SPMD hang)')
+  hint = ('hoist the collective out of the rank conditional; keep only '
+          'the rank-local work (logging, file writes) inside it')
+
+  def on_node(self, node, ctx):
+    if not (isinstance(node, ast.Call) and
+            isinstance(node.func, ast.Attribute) and
+            node.func.attr in _COLLECTIVES):
+      return
+    dotted, _ = ctx.call_name(node)
+    if dotted and dotted.startswith(('numpy.', 'jax.lax.', 'jax.numpy.')):
+      return  # array shape ops (e.g. lax.broadcast), not collectives
+    for anc in ctx.ancestors:
+      if isinstance(anc, (ast.If, ast.While, ast.IfExp)):
+        ident = _rank_mention(anc.test)
+        if ident:
+          yield self.finding(
+              node, f'collective {node.func.attr}() inside a branch '
+              f'conditioned on {ident!r}: ranks disagreeing on the '
+              'branch deadlock the collective', ctx)
+          return
+
+
+def default_rules():
+  """Fresh instances of every shipped rule, in rule-id order."""
+  return [
+      UnsortedFsIteration(),
+      GlobalStateRng(),
+      WallClockControlFlow(),
+      UnscopedResource(),
+      RankConditionalCollective(),
+  ]
+
+
+def rules_by_id():
+  return {r.rule_id: r for r in default_rules()}

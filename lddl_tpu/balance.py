@@ -96,17 +96,17 @@ def plan_shards(files, num_shards):
 def _read_row_range(path, a, b):
   """Read rows [a, b) of a Parquet file, touching only the row groups that
   overlap the range (not the whole file)."""
-  pf = pq.ParquetFile(path)
-  md = pf.metadata
-  offsets = np.cumsum(
-      [0] + [md.row_group(i).num_rows for i in range(md.num_row_groups)])
-  groups = [
-      i for i in range(md.num_row_groups)
-      if offsets[i + 1] > a and offsets[i] < b
-  ]
-  if not groups:
-    return pf.schema_arrow.empty_table()
-  table = pf.read_row_groups(groups)
+  with pq.ParquetFile(path) as pf:
+    md = pf.metadata
+    offsets = np.cumsum(
+        [0] + [md.row_group(i).num_rows for i in range(md.num_row_groups)])
+    groups = [
+        i for i in range(md.num_row_groups)
+        if offsets[i + 1] > a and offsets[i] < b
+    ]
+    if not groups:
+      return pf.schema_arrow.empty_table()
+    table = pf.read_row_groups(groups)
   return table.slice(a - int(offsets[groups[0]]), b - a)
 
 

@@ -17,6 +17,9 @@ fork's CodeBERT wrapper), all thin delegates:
                                     per-rank trace JSONL into one
                                     clock-aligned Chrome-trace JSON
                                     for Perfetto / chrome://tracing)
+  lddl_analyze                   -> lddl_tpu.analysis.cli (SPMD
+                                    determinism & resource-safety
+                                    linter; the tier-1 self-check gate)
 
 Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
 installed console scripts.
@@ -95,6 +98,11 @@ def telemetry_trace(args=None):
   return main(args)
 
 
+def lddl_analyze(args=None):
+  from .analysis.cli import main
+  return main(args)
+
+
 _COMMANDS = {
     'download_wikipedia': download_wikipedia,
     'download_books': download_books,
@@ -113,6 +121,8 @@ _COMMANDS = {
     'telemetry-report': telemetry_report,  # dash-form alias
     'telemetry_trace': telemetry_trace,
     'telemetry-trace': telemetry_trace,  # dash-form alias
+    'lddl_analyze': lddl_analyze,
+    'lddl-analyze': lddl_analyze,  # dash-form alias
 }
 
 

@@ -186,11 +186,11 @@ def write_corpus(out_dir, target_mb, num_shards=4, seed=0, id_prefix='synth'):
   os.makedirs(out_dir, exist_ok=True)
   words, probs = build_word_population()
   target = int(target_mb * 1024 * 1024)
-  files = [
-      open(os.path.join(out_dir, f'{i}.txt'), 'w', encoding='utf-8')
-      for i in range(num_shards)
-  ]
+  files = []
   try:
+    files.extend(
+        open(os.path.join(out_dir, f'{i}.txt'), 'w', encoding='utf-8')
+        for i in range(num_shards))
     written = 0
     for doc_id, doc in enumerate(
         generate_documents(words, probs, target, seed=seed)):

@@ -97,7 +97,8 @@ def get_file_paths_for_bin_id(file_paths, bin_id):
 
 def get_num_samples_of_parquet(path):
   """Number of rows of a Parquet file, from footer metadata only."""
-  return pq.ParquetFile(path).metadata.num_rows
+  with pq.ParquetFile(path) as pf:
+    return pf.metadata.num_rows
 
 
 def count_parquet_samples_strided(paths, comm=None):

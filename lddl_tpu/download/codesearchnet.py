@@ -76,9 +76,9 @@ def split_raw(data_dir, out_dir, langs=LANGS):
   ``<out>/<lang>_<split>.pkl`` (list of (id, definition-dict))."""
   out_dir = expand_outdir_and_mkdir(out_dir)
   for lang in langs:
-    defs = pickle.load(
-        open(os.path.join(data_dir, f'{lang}_dedupe_definitions_v2.pkl'),
-             'rb'))
+    with open(os.path.join(data_dir, f'{lang}_dedupe_definitions_v2.pkl'),
+              'rb') as f:
+      defs = pickle.load(f)
     split_hashes = {s: _jsonl_code_hashes(data_dir, lang, s) for s in SPLITS}
     def_hashes = [_stable_hash(item['function']) for item in defs]
     for split in SPLITS:
@@ -104,8 +104,8 @@ def extract_raw(in_dir, out_dir, langs=LANGS, splits=SPLITS):
   for split in splits:
     ids, docs, codes = [], [], []
     for lang in langs:
-      kept = pickle.load(
-          open(os.path.join(in_dir, f'{lang}_{split}.pkl'), 'rb'))
+      with open(os.path.join(in_dir, f'{lang}_{split}.pkl'), 'rb') as f:
+        kept = pickle.load(f)
       bimodal = sum(1 for _, item in kept if item.get('docstring'))
       for item_id, item in kept:
         ids.append(item_id)
@@ -122,7 +122,8 @@ def shard_data(extracted_pkl, out_dir, num_blocks=4096, seed=12345):
   """Seeded global shuffle -> ``block_<i>.txt`` CRLF-delimited shards of
   ``id<CODESPLIT>docstring<CODESPLIT>code`` records."""
   out_dir = expand_outdir_and_mkdir(out_dir)
-  ids, docs, codes = pickle.load(open(extracted_pkl, 'rb'))
+  with open(extracted_pkl, 'rb') as f:
+    ids, docs, codes = pickle.load(f)
   records = [
       CODE_SPLIT.join(item).replace(LINE_DELIMITER, '\n')
       for item in zip(ids, docs, codes)
@@ -153,7 +154,8 @@ def train_tokenizer(extracted_pkl, out_dir, vocab_size=52000,
 
   from transformers import BertTokenizerFast
   out_dir = expand_outdir_and_mkdir(out_dir)
-  _, _, codes = pickle.load(open(extracted_pkl, 'rb'))
+  with open(extracted_pkl, 'rb') as f:
+    _, _, codes = pickle.load(f)
   # Template tokenizer: a minimal WordPiece whose *configuration* (normalizer,
   # pre-tokenizer, specials) seeds train_new_from_iterator; its vocab is
   # discarded by training.

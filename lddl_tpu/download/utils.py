@@ -62,12 +62,12 @@ def shard_documents(docs, outdir, num_shards):
   empties are dropped.
   """
   os.makedirs(outdir, exist_ok=True)
-  files = [
-      open(os.path.join(outdir, f'{i}.txt'), 'w', encoding='utf-8')
-      for i in range(num_shards)
-  ]
   counts = [0] * num_shards
+  files = []
   try:
+    files.extend(
+        open(os.path.join(outdir, f'{i}.txt'), 'w', encoding='utf-8')
+        for i in range(num_shards))
     i = 0
     for doc_id, text in docs:
       if _write_doc_line(files[i % num_shards], doc_id, text):

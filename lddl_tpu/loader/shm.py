@@ -104,6 +104,10 @@ class BatchRing:
     self.num_slots = int(num_slots)
     self.slot_bytes = int(slot_bytes)
     if _segment is None:
+      # lddl: noqa[LDA004] the ring owns the segment for its whole life:
+      # the parent's iterator cleanup calls destroy() (unlink+close) on
+      # every exit path, including consumer abandonment and SIGKILLed
+      # workers — a with-block here could not outlive __init__.
       _segment = _shared_memory.SharedMemory(
           name=f'{SEGMENT_PREFIX}{os.getpid()}_{uuid.uuid4().hex[:12]}',
           create=True, size=self.num_slots * self.slot_bytes)
@@ -123,6 +127,9 @@ class BatchRing:
     the re-registration dedupes and the parent's single ``unlink``
     balances it. Unregistering here instead would strip the shared
     entry and make the parent's unlink trip a tracker KeyError."""
+    # lddl: noqa[LDA004] worker-side mapping of a parent-owned name: the
+    # worker loop closes it in its finally; the parent's unlink is the
+    # authoritative release (no worker cooperation needed).
     seg = _shared_memory.SharedMemory(name=name)
     return cls(num_slots, slot_bytes, _segment=seg)
 

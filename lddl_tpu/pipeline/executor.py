@@ -71,6 +71,8 @@ class ProgressReporter:
       # Rate baseline starts at the first completion we observe for the
       # phase — computing it from `done / ~0s` would print absurd rates.
       self._label, self._t0, self._done0 = label, now, done
+    # lddl: noqa[LDA003] progress-print rate limit: reporting is
+    # rank-local observability; skipping a heartbeat changes no plan.
     if not force and now - self._last < 2.0:
       return
     self._last = now

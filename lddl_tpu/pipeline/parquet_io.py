@@ -101,19 +101,31 @@ def write_table_partition(
   os.makedirs(out_dir, exist_ok=True)
 
   def _write(tbl, path):
-    if output_format == 'parquet':
-      # Dictionary encoding buys nothing on long, mostly-unique token
-      # strings, and per-page statistics are never consulted by the
-      # loader (row counts come from the footer) — both are pure
-      # writer-side cost here.
-      pq.write_table(tbl, path, compression=compression,
-                     use_dictionary=False, write_statistics=False)
-    elif output_format == 'txt':
-      with open(path, 'w', encoding='utf-8') as f:
-        for row in tbl.to_pylist():
-          f.write(repr(row) + '\n')
-    else:
-      raise ValueError(f'unknown output_format {output_format!r}')
+    # Write to a tmp name in out_dir, then rename: a preprocessor killed
+    # mid-write must never leave a truncated part file that shard
+    # discovery (which matches on the final extension only) would read
+    # as valid (same tmp+rename discipline as pipeline/shuffle.py). The
+    # leading dot plus '.tmp' extension keeps the tmp name invisible to
+    # get_all_parquets_under/get_all_txt_files_under even mid-write.
+    tmp = os.path.join(out_dir, f'.{os.path.basename(path)}.tmp')
+    try:
+      if output_format == 'parquet':
+        # Dictionary encoding buys nothing on long, mostly-unique token
+        # strings, and per-page statistics are never consulted by the
+        # loader (row counts come from the footer) — both are pure
+        # writer-side cost here.
+        pq.write_table(tbl, tmp, compression=compression,
+                       use_dictionary=False, write_statistics=False)
+      elif output_format == 'txt':
+        with open(tmp, 'w', encoding='utf-8') as f:
+          for row in tbl.to_pylist():
+            f.write(repr(row) + '\n')
+      else:
+        raise ValueError(f'unknown output_format {output_format!r}')
+      os.rename(tmp, path)
+    finally:
+      if os.path.exists(tmp):
+        os.remove(tmp)
 
   ext = 'parquet' if output_format == 'parquet' else 'txt'
   if bin_size is None:

@@ -40,6 +40,7 @@ setup(
             'balance_shards=lddl_tpu.cli:balance_shards',
             'generate_num_samples_cache='
             'lddl_tpu.cli:generate_num_samples_cache',
+            'lddl-analyze=lddl_tpu.analysis.cli:main',
         ],
     },
 )

@@ -1,0 +1,404 @@
+"""Per-rule fixture tests for the lddl-analyze linter: every rule has a
+flagged (positive) and clean (negative) snippet, pragmas suppress, and
+the CLI's --json output honors its schema."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from lddl_tpu.analysis import analyze_source
+from lddl_tpu.analysis.cli import main as cli_main
+from lddl_tpu.analysis.rules import default_rules
+
+
+def run(src, path='lddl_tpu/pkg/mod.py'):
+  """Unsuppressed rule ids found in a dedented snippet."""
+  findings = analyze_source(textwrap.dedent(src), path=path)
+  return [f.rule_id for f in findings if not f.suppressed]
+
+
+def run_findings(src, path='lddl_tpu/pkg/mod.py'):
+  return analyze_source(textwrap.dedent(src), path=path)
+
+
+# ---------------------------------------------------------------------------
+# LDA001: unsorted filesystem iteration
+
+
+def test_lda001_flags_unsorted_listdir_and_glob():
+  assert run("""
+      import glob
+      import os
+      for f in os.listdir(d):
+        use(f)
+      paths = glob.glob(pattern)
+      """) == ['LDA001', 'LDA001']
+
+
+def test_lda001_flags_path_iterdir():
+  assert 'LDA001' in run("""
+      from pathlib import Path
+      names = list(Path(root).glob('*.txt'))
+      entries = [p for p in base.iterdir()]
+      """)
+
+
+def test_lda001_clean_when_sorted():
+  assert run("""
+      import glob
+      import os
+      paths = sorted(glob.glob(pattern))
+      names = sorted(f for f in os.listdir(d) if f.endswith('.txt'))
+      tree = sorted(os.path.join(r, f) for r, _, fs in os.walk(root)
+                    for f in fs)
+      """) == []
+
+
+def test_lda001_pragma_suppresses():
+  findings = run_findings("""
+      import os
+      names = os.listdir(d)  # lddl: noqa[LDA001] order discarded below
+      """)
+  assert [f.rule_id for f in findings] == ['LDA001']
+  assert findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# LDA002: global-state RNG
+
+
+def test_lda002_flags_global_rng():
+  assert run("""
+      import random
+      import numpy as np
+      random.shuffle(x)
+      np.random.seed(0)
+      v = np.random.rand(3)
+      g = np.random.default_rng()
+      """) == ['LDA002'] * 4
+
+
+def test_lda002_clean_for_seeded_constructions():
+  assert run("""
+      import random
+      import numpy as np
+      from numpy.random import default_rng
+      r = random.Random(1234)
+      g = np.random.Generator(np.random.Philox(key=[1, 2]))
+      h = default_rng(42)
+      s = np.random.SeedSequence([seed, idx])
+      """) == []
+
+
+def test_lda002_relative_random_module_not_confused_with_stdlib():
+  # ``from ..core import random as lrandom`` is this repo's seeded-RNG
+  # module; its calls must never be mistaken for stdlib ``random``.
+  assert run("""
+      from ..core import random as lrandom
+      state = lrandom.shuffle(lines, rng_state=state)
+      """) == []
+
+
+def test_lda002_exempt_in_tests_and_core_random():
+  src = """
+      import random
+      random.shuffle(x)
+      """
+  assert run(src, path='lddl_tpu/core/random.py') == []
+  assert run(src, path='tests/test_whatever.py') == []
+  assert run(src) == ['LDA002']
+
+
+# ---------------------------------------------------------------------------
+# LDA003: wall-clock in control flow
+
+
+def test_lda003_flags_direct_clock_branch():
+  assert run("""
+      import time
+      def poll():
+        while time.monotonic() < deadline:
+          step()
+      """) == ['LDA003']
+
+
+def test_lda003_flags_tainted_name_in_branch():
+  assert run("""
+      import time
+      def wait(timeout):
+        deadline = time.time() + timeout
+        if t > deadline:
+          raise TimeoutError
+      """) == ['LDA003']
+
+
+def test_lda003_clean_for_measurement_only():
+  assert run("""
+      import time
+      def timed(fn):
+        t0 = time.monotonic()
+        fn()
+        return time.monotonic() - t0
+      """) == []
+
+
+def test_lda003_exempt_under_telemetry():
+  src = """
+      import time
+      if time.time() > t1:
+        flush()
+      """
+  assert run(src, path='lddl_tpu/telemetry/metrics.py') == []
+  assert run(src) == ['LDA003']
+
+
+def test_lda003_attribute_assignment_does_not_taint_self():
+  assert run("""
+      import time
+      class Reporter:
+        def tick(self):
+          self.t0 = time.monotonic()
+          if self.enabled:
+            self.emit()
+      """) == []
+
+
+def test_lda003_taint_does_not_cross_functions():
+  assert run("""
+      import time
+      def a():
+        now = time.monotonic()
+        return now
+      def b(now):
+        if now > 5:
+          go()
+      """) == []
+
+
+# ---------------------------------------------------------------------------
+# LDA004: resource acquisition without scoped release
+
+
+def test_lda004_flags_unscoped_acquisitions():
+  assert run("""
+      import pyarrow.parquet as pq
+      from multiprocessing.shared_memory import SharedMemory
+      pf = pq.ParquetFile(path)
+      f = open(path)
+      seg = SharedMemory(name=name)
+      """) == ['LDA004'] * 3
+
+
+def test_lda004_flags_chained_leak():
+  # The PR-3 leak class: the handle is born and orphaned in one
+  # expression.
+  assert run("""
+      import pyarrow.parquet as pq
+      def rows(path):
+        return pq.ParquetFile(path).metadata.num_rows
+      """) == ['LDA004']
+
+
+def test_lda004_clean_under_with_and_try_finally():
+  assert run("""
+      import pyarrow.parquet as pq
+      from contextlib import closing
+      with pq.ParquetFile(path) as pf:
+        n = pf.metadata.num_rows
+      with open(path) as f:
+        f.read()
+      with closing(open(path)) as f:
+        f.read()
+      files = []
+      try:
+        files.append(open(path))
+        work(files)
+      finally:
+        for f in files:
+          f.close()
+      """) == []
+
+
+def test_lda004_pragma_with_reason_suppresses():
+  findings = run_findings("""
+      from multiprocessing.shared_memory import SharedMemory
+      # lddl: noqa[LDA004] ring owns the segment; destroy() unlinks it
+      seg = SharedMemory(name=name, create=True, size=1 << 20)
+      """)
+  assert [f.rule_id for f in findings] == ['LDA004']
+  assert findings[0].suppressed
+
+
+# ---------------------------------------------------------------------------
+# LDA005: collective inside a rank-conditional branch
+
+
+def test_lda005_flags_rank_conditional_collective():
+  assert run("""
+      if comm.rank == 0:
+        write_manifest()
+        comm.barrier()
+      """) == ['LDA005']
+  assert run("""
+      def sync(backend):
+        if backend.rank != 0:
+          return backend.broadcast_object(None)
+      """) == ['LDA005']
+
+
+def test_lda005_clean_for_uniform_collectives():
+  assert run("""
+      counts = comm.allreduce_sum(counts)
+      if comm.world_size > 1:
+        comm.barrier()
+      if comm.rank == 0:
+        print('done')
+      """) == []
+
+
+def test_lda005_ignores_numpy_broadcast():
+  assert run("""
+      import numpy as np
+      if rank == 0:
+        shape = np.broadcast(a, b).shape
+      """) == []
+
+
+# ---------------------------------------------------------------------------
+# Engine / pragmas / CLI
+
+
+def test_parse_error_is_a_finding():
+  findings = run_findings('def broken(:\n')
+  assert [f.rule_id for f in findings] == ['LDA000']
+
+
+def test_standalone_pragma_covers_whole_statement():
+  findings = run_findings("""
+      import os
+      # lddl: noqa[LDA001] aggregate is sorted before use
+      out.extend(
+          os.path.join(r, f)
+          for r, _, fs in os.walk(p)
+          for f in fs)
+      """)
+  assert [f.rule_id for f in findings] == ['LDA001']
+  assert findings[0].suppressed
+
+
+def test_bare_noqa_suppresses_everything():
+  findings = run_findings("""
+      import os
+      names = os.listdir(d)  # lddl: noqa
+      """)
+  assert findings and all(f.suppressed for f in findings)
+
+
+def test_pragma_in_string_literal_does_not_suppress():
+  findings = run_findings("""
+      import os
+      msg = '# lddl: noqa[LDA001]'
+      names = os.listdir(d)
+      """)
+  assert [f.rule_id for f in findings if not f.suppressed] == ['LDA001']
+
+
+def _write(tmp_path, name, body):
+  p = tmp_path / name
+  p.write_text(textwrap.dedent(body))
+  return str(p)
+
+
+def test_cli_json_schema(tmp_path, capsys):
+  dirty = _write(tmp_path, 'dirty.py', """
+      import os
+      names = os.listdir(d)
+      ok = os.listdir(e)  # lddl: noqa[LDA001] consumed as a set
+      """)
+  rc = cli_main(['--json', dirty])
+  out = json.loads(capsys.readouterr().out)
+  assert rc == 1
+  assert out['version'] == 1
+  assert out['files_scanned'] == 1
+  assert out['num_findings'] == 1
+  assert out['num_suppressed'] == 1
+  assert out['clean'] is False
+  assert len(out['findings']) == 2
+  for f in out['findings']:
+    assert set(f) == {
+        'rule', 'path', 'line', 'col', 'message', 'hint', 'suppressed'
+    }
+    assert f['rule'] == 'LDA001'
+  flagged = [f for f in out['findings'] if not f['suppressed']]
+  assert flagged[0]['line'] == 3
+
+
+def test_cli_rule_filter(tmp_path, capsys):
+  mixed = _write(tmp_path, 'mixed.py', """
+      import os
+      names = os.listdir(d)
+      f = open(p)
+      """)
+  rc = cli_main(['--json', '--rule', 'LDA004', mixed])
+  out = json.loads(capsys.readouterr().out)
+  assert rc == 1
+  assert [f['rule'] for f in out['findings']] == ['LDA004']
+  assert cli_main(['--rule', 'LDA999', mixed]) == 2
+  capsys.readouterr()
+
+
+def test_cli_clean_exit_zero(tmp_path, capsys):
+  clean = _write(tmp_path, 'clean.py', """
+      import os
+      names = sorted(os.listdir(d))
+      """)
+  assert cli_main([clean]) == 0
+  assert 'clean' in capsys.readouterr().out
+
+
+def test_cli_list_rules(capsys):
+  assert cli_main(['--list-rules']) == 0
+  out = capsys.readouterr().out
+  for rule in default_rules():
+    assert rule.rule_id in out
+
+
+def test_cli_missing_path(tmp_path, capsys):
+  assert cli_main([str(tmp_path / 'nope')]) == 2
+  capsys.readouterr()
+
+
+def test_cli_changed_filter(tmp_path, capsys, monkeypatch):
+  if not any(
+      os.access(os.path.join(d, 'git'), os.X_OK)
+      for d in os.environ.get('PATH', '').split(os.pathsep) if d):
+    pytest.skip('git not available')
+  repo = tmp_path / 'repo'
+  repo.mkdir()
+  monkeypatch.chdir(repo)
+  env = dict(os.environ,
+             GIT_AUTHOR_NAME='t', GIT_AUTHOR_EMAIL='t@t',
+             GIT_COMMITTER_NAME='t', GIT_COMMITTER_EMAIL='t@t')
+
+  def git(*args):
+    subprocess.run(['git', *args], check=True, env=env,
+                   capture_output=True)
+
+  git('init', '-q')
+  committed = repo / 'committed.py'
+  committed.write_text('import os\nnames = os.listdir(d)\n')
+  git('add', '.')
+  git('commit', '-q', '-m', 'seed')
+  fresh = repo / 'fresh.py'
+  fresh.write_text('import os\nother = os.listdir(e)\n')
+  rc = cli_main(['--json', '--changed', '.'])
+  out = json.loads(capsys.readouterr().out)
+  # Only the untracked file is analyzed; the committed-and-unchanged
+  # dirty file is filtered out.
+  assert rc == 1
+  assert out['files_scanned'] == 1
+  assert all('fresh.py' in f['path'] for f in out['findings'])
