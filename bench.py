@@ -221,10 +221,15 @@ def main():
         'unit': 'MB/s/chip',
         'vs_baseline': round(ours_mbps / ref_mbps, 3),
         'dup1_mb_per_sec_per_chip': round(dup1_mbps, 3),
+        # The scheduler the numbers were measured under (workers, start
+        # method, LPT+stealing, async write-back) — a BENCH line is not
+        # comparable across scheduler configs without this.
+        'scheduler': executor.scheduler_info(),
     }
     result.update(_telemetry_artifacts())
     result.update(_lint_status())
     print(json.dumps(result))
+    executor.close()
   finally:
     shutil.rmtree(work, ignore_errors=True)
 

@@ -333,6 +333,80 @@ class RankConditionalCollective(Rule):
           return
 
 
+# ---------------------------------------------------------------------------
+# LDA006: worker-pool churn
+
+
+_POOL_EXECUTORS = frozenset({'ProcessPoolExecutor', 'ThreadPoolExecutor'})
+_POOL_LIFECYCLE_METHODS = frozenset({'__init__', '__new__', '__enter__'})
+
+
+class PoolChurn(Rule):
+  rule_id = 'LDA006'
+  name = 'pool-churn'
+  invariant = ('worker pools have a lifetime, not a call site: a pool '
+               'constructed per loop iteration or per method call re-pays '
+               'worker spawn + per-worker warmup (tokenizer, native '
+               'encoder) on every phase')
+  hint = ('hoist the pool to an owner with a lifetime (create lazily '
+          'once, reuse across phases, close() at teardown) — e.g. '
+          'pipeline.pool.WorkerPool owned by Executor')
+
+  def exempt(self, ctx):
+    # Tests/benchmark scaffolding may build throwaway pools on purpose.
+    if ctx.path_is('tests/'):
+      return True
+    base = ctx.basename()
+    return (base.startswith('test_') or
+            base in ('conftest.py', 'testing.py'))
+
+  def _pool_name(self, node, ctx):
+    dotted, term = ctx.call_name(node)
+    if term in _POOL_EXECUTORS:
+      return term
+    if term == 'Pool' and (isinstance(node.func, ast.Attribute) or
+                           (dotted and 'multiprocessing' in dotted)):
+      # mp.Pool / ctx.Pool / multiprocessing.Pool; a bare local Pool()
+      # class of unrelated meaning is not flagged.
+      return 'Pool'
+    return None
+
+  def on_node(self, node, ctx):
+    if not isinstance(node, ast.Call):
+      return
+    what = self._pool_name(node, ctx)
+    if what is None:
+      return
+    for anc in ctx.ancestors:
+      if isinstance(anc, (ast.For, ast.AsyncFor, ast.While)):
+        yield self.finding(
+            node, f'{what}() constructed inside a loop: every iteration '
+            're-pays worker spawn and per-worker warmup (pool churn)',
+            ctx)
+        return
+    func = ctx.enclosing(ast.FunctionDef, ast.AsyncFunctionDef)
+    if func is None or func.name in _POOL_LIFECYCLE_METHODS:
+      return
+    params = func.args.posonlyargs + func.args.args
+    if not params or params[0].arg not in ('self', 'cls'):
+      return
+    ancestors = list(ctx.ancestors)
+    fi = ancestors.index(func)
+    if not any(isinstance(a, ast.ClassDef) for a in ancestors[:fi]):
+      return  # self-named first arg on a plain function, not a method
+    for anc in reversed(ancestors):
+      if isinstance(anc, ast.Assign):
+        for t in anc.targets:
+          if (isinstance(t, ast.Attribute) and
+              isinstance(t.value, ast.Name) and
+              t.value.id in ('self', 'cls')):
+            return  # cached on the instance: a lifetime, not churn
+    yield self.finding(
+        node, f'{what}() constructed per call of method {func.name!r}: '
+        'every invocation re-pays worker spawn and per-worker warmup '
+        'instead of reusing a pool with a lifetime (pool churn)', ctx)
+
+
 def default_rules():
   """Fresh instances of every shipped rule, in rule-id order."""
   return [
@@ -341,6 +415,7 @@ def default_rules():
       WallClockControlFlow(),
       UnscopedResource(),
       RankConditionalCollective(),
+      PoolChurn(),
   ]
 
 

@@ -1,4 +1,5 @@
 from .partition import TextSlice, estimate_block_size, plan_text_partitions, read_lines
 from .executor import Executor
+from .pool import AsyncShardWriter, PoolBroken, WorkerPool, current_writer
 from .shuffle import shuffle_lines
-from .parquet_io import write_samples_partition, read_samples
+from .parquet_io import write_samples_partition, write_shard_file, read_samples

@@ -7,24 +7,32 @@ uses Dask: embarrassingly-parallel ``map`` over partitions, one global
 shuffle, and metadata gathers.
 
 Topology: the global task list is strided across comm ranks
-(``tasks[rank::world]``); each rank fans its share out to a local process
-pool. On TPU-VM pods, one rank per host with ``JaxProcessBackend`` gives
-multi-host scaling without MPI; results (small metadata only — bulk data
-goes through the shared filesystem) are re-gathered with the backend's
-collectives.
+(``tasks[rank::world]``); each rank fans its share out to a local
+**persistent** worker pool (``pool.WorkerPool``): created lazily on the
+first pooled ``map()``, reused across every later phase of the run (warm
+tokenizer/native-encoder state via registered warmup hooks), torn down by
+``close()`` / context-manager exit. Within a rank, dispatch is
+work-stealing off one shared queue with tasks enqueued largest-first
+(LPT by a deterministic cost key); across ranks the plan stays the pure
+stride above — no extra collectives. On TPU-VM pods, one rank per host
+with ``JaxProcessBackend`` gives multi-host scaling without MPI; results
+(small metadata only — bulk data goes through the shared filesystem) are
+re-gathered with the backend's collectives.
 """
 
-import concurrent.futures as _cf
 import json
 import multiprocessing as _mp
 import os
 import sys
 import tempfile
 import time
+import weakref
 
 from ..comm import NullBackend
 from ..telemetry import get_telemetry
 from ..telemetry.trace import get_tracer
+from .pool import (AsyncShardWriter, PoolBroken, WorkerPool,
+                   _default_mp_context, install_writer, write_back_enabled)
 
 
 def _run_task(fn, global_index, task):
@@ -52,6 +60,10 @@ class ProgressReporter:
       ``lddl_status.rank<R>.json`` (atomic rename), refreshed every
       >=2 s — tail/watch them from another terminal, or compare ranks'
       ``done``/``updated_unix`` to spot stragglers and dead ranks.
+
+  When a phase finishes, :meth:`finish` replaces the heartbeat with a
+  final ``{"phase": ..., "complete": true, "workers": N}`` record — so a
+  status file left on disk after the run never claims an in-flight phase.
   """
 
   def __init__(self, spec, rank):
@@ -65,7 +77,7 @@ class ProgressReporter:
     self._done0 = 0
     self._last = 0.0
 
-  def update(self, label, done, total, force=False):
+  def update(self, label, done, total, force=False, extra=None):
     now = time.monotonic()
     if label != self._label:
       # Rate baseline starts at the first completion we observe for the
@@ -82,36 +94,40 @@ class ProgressReporter:
     if self._stderr:
       rate_s = f'{rate:.1f}/s' if rate else '--/s'
       eta_s = f'eta {eta:.0f}s' if eta is not None else 'eta --'
+      tail = ' done' if extra and extra.get('complete') else ''
       print(f'[lddl {label}] rank {self._rank}: {done}/{total} '
-            f'({rate_s}, {eta_s})', file=sys.stderr, flush=True)
+            f'({rate_s}, {eta_s}){tail}', file=sys.stderr, flush=True)
       return
-    payload = json.dumps({
+    record = {
         'rank': self._rank, 'pid': os.getpid(), 'phase': label,
         'done': done, 'total': total,
         'tasks_per_sec': round(rate, 3) if rate else None,
         'eta_sec': round(eta, 1) if eta is not None else None,
         'updated_unix': time.time(),
-    })
+    }
+    if extra:
+      record.update(extra)
+    payload = json.dumps(record)
     fd, tmp = tempfile.mkstemp(dir=self._dir)
     with os.fdopen(fd, 'w') as f:
       f.write(payload)
     os.replace(tmp, os.path.join(self._dir,
                                  f'lddl_status.rank{self._rank}.json'))
 
-
-def _default_mp_context():
-  """fork is fastest, but forking a process that has initialized JAX (its
-  runtime holds locks in background threads) can deadlock the child — so
-  once ``jax`` is imported anywhere in the process, pool workers come from
-  a clean forkserver instead."""
-  if 'jax' in sys.modules and 'forkserver' in _mp.get_all_start_methods():
-    return _mp.get_context('forkserver')
-  if 'jax' in sys.modules:
-    return _mp.get_context('spawn')
-  return None  # platform default (fork on Linux)
+  def finish(self, label, total, workers):
+    """Write the phase's terminal record (``complete: true``) so stale
+    heartbeats never masquerade as an in-flight phase."""
+    self.update(label, total, total, force=True,
+                extra={'complete': True, 'workers': workers})
 
 
 class Executor:
+  """Rank-local scheduler over a persistent worker pool.
+
+  Use as a context manager (or call :meth:`close`) so the pool is torn
+  down deterministically; a leaked Executor still reaps its workers via
+  a GC finalizer, but only close() guarantees *when*.
+  """
 
   def __init__(self, comm=None, num_local_workers=None, mp_start_method=None):
     self._comm = comm if comm is not None else NullBackend()
@@ -119,10 +135,13 @@ class Executor:
       num_local_workers = max(1, (os.cpu_count() or 1))
     self._num_local_workers = num_local_workers
     # An explicit start method sticks; otherwise the context is resolved at
-    # map() time so a jax import *after* construction still switches the
-    # pool off fork.
+    # pool-creation time so a jax import *after* construction still
+    # switches the pool off fork.
     self._mp_context = (_mp.get_context(mp_start_method)
                         if mp_start_method else None)
+    self._pool = None
+    self._finalizer = None
+    self._warmups = {}  # key -> zero-arg picklable callable
     spec = os.environ.get('LDDL_PROGRESS', '')
     # '0'/'false'/'off' must disable, not become a directory named '0'.
     self._progress = (ProgressReporter(spec, self._comm.rank)
@@ -136,15 +155,85 @@ class Executor:
   def num_local_workers(self):
     return self._num_local_workers
 
-  def map(self, fn, tasks, gather=True, label='map'):
+  # -- persistent pool lifecycle --------------------------------------------
+
+  def set_warmup(self, fn, key=None):
+    """Register a zero-arg picklable warmup hook (tokenizer / native
+    encoder pre-load). Runs once per worker per pool lifetime: at worker
+    startup for hooks registered before the pool exists, via an immediate
+    broadcast for hooks registered after. Duplicate keys are ignored, so
+    phases can re-register their warmup idempotently."""
+    key = key if key is not None else fn
+    if key in self._warmups:
+      return
+    self._warmups[key] = fn
+    if self._pool is not None:
+      self._pool.broadcast(fn)
+
+  def _get_pool(self):
+    if self._pool is None:
+      pool = WorkerPool(
+          self._num_local_workers,
+          mp_context=self._mp_context or _default_mp_context(),
+          warmups=tuple(self._warmups.values()))
+      self._pool = pool
+      # Reap workers even if the owner forgets close(); holds only the
+      # pool (not self), so the Executor stays collectable.
+      self._finalizer = weakref.finalize(self, pool.shutdown)
+    return self._pool
+
+  def _drop_pool(self, force=False):
+    if self._finalizer is not None:
+      self._finalizer.detach()
+      self._finalizer = None
+    if self._pool is not None:
+      pool, self._pool = self._pool, None
+      pool.shutdown(force=force)
+
+  def close(self):
+    """Tear down the persistent pool (idempotent)."""
+    self._drop_pool()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, exc_type, exc, tb):
+    self.close()
+    return False
+
+  def scheduler_info(self):
+    """Scheduler configuration for bench/telemetry stamping."""
+    if self._pool is not None:
+      start_method = self._pool.start_method
+    else:
+      ctx = self._mp_context or _default_mp_context()
+      start_method = (getattr(ctx, '_name', None) if ctx else None) \
+          or _mp.get_start_method(allow_none=True) or 'fork'
+    return {
+        'workers': self._num_local_workers,
+        'start_method': start_method,
+        'persistent_pool': self._num_local_workers > 1,
+        'stealing': self._num_local_workers > 1,
+        'lpt': self._num_local_workers > 1,
+        'write_back': write_back_enabled(),
+    }
+
+  # -- map ------------------------------------------------------------------
+
+  def map(self, fn, tasks, gather=True, label='map', cost_key=None):
     """Run ``fn(task, global_index)`` for every task.
 
-    Tasks are strided over comm ranks, then over the local process pool.
-    With ``gather=True`` every rank returns the full, task-ordered result
-    list (results must be picklable metadata, not bulk data); with
-    ``gather=False`` each rank returns only ``[(global_index, result), ...]``
-    for its own tasks, followed by a barrier. ``label`` names the phase
-    in live progress reporting (env ``LDDL_PROGRESS``).
+    Tasks are strided over comm ranks, then fed to the rank's persistent
+    worker pool through one shared queue in size-descending (LPT) order
+    of ``cost_key(task, global_index)`` (any deterministic numeric — e.g.
+    input shard bytes; defaults to the index). Scheduling never changes
+    results: task output is a function of ``(task, global_index)`` only,
+    and the return value is task-ordered. With ``gather=True`` every rank
+    returns the full result list (results must be picklable metadata, not
+    bulk data); with ``gather=False`` each rank returns only
+    ``[(global_index, result), ...]`` for its own tasks (ordered by
+    global index), followed by a barrier. ``label`` names the phase in
+    live progress reporting (env ``LDDL_PROGRESS``).
     """
     tasks = list(tasks)
     rank = self._comm.rank
@@ -162,34 +251,16 @@ class Executor:
     map_span = tele.span(f'pipeline.{label}.map_seconds')
     t_map = time.monotonic()
     map_span.__enter__()
-    if self._num_local_workers <= 1 or len(my_indices) <= 1:
-      for i in my_indices:
-        gi, res, t0, dt, pid = _run_task(fn, i, tasks[i])
-        task_hist.observe(dt)
-        tasks_done.add(1)
-        tracer.complete(task_name, t0, dt, tid=pid)
-        local_results.append((gi, res))
-        if self._progress:
-          self._progress.update(label, len(local_results), total,
-                                force=len(local_results) == total)
+    pooled = self._num_local_workers > 1 and len(my_indices) > 1
+    if not pooled:
+      self._map_serial(fn, tasks, my_indices, label, task_name,
+                       task_hist, tasks_done, tracer, tele, local_results)
     else:
-      with _cf.ProcessPoolExecutor(
-          max_workers=min(self._num_local_workers, len(my_indices)),
-          mp_context=self._mp_context or _default_mp_context()) as pool:
-        futures = [pool.submit(_run_task, fn, i, tasks[i]) for i in my_indices]
-        if self._progress:
-          # Completion-ordered accounting for the live view; results are
-          # still read back in task order below.
-          done = 0
-          for _ in _cf.as_completed(futures):
-            done += 1
-            self._progress.update(label, done, total, force=done == total)
-        for fut in futures:
-          gi, res, t0, dt, pid = fut.result()
-          task_hist.observe(dt)
-          tasks_done.add(1)
-          tracer.complete(task_name, t0, dt, tid=pid)
-          local_results.append((gi, res))
+      self._map_pooled(fn, tasks, my_indices, label, task_name, cost_key,
+                       task_hist, tasks_done, tracer, tele, local_results)
+    if self._progress:
+      self._progress.finish(label, total,
+                            self._num_local_workers if pooled else 1)
     map_span.__exit__(None, None, None)
     if tracer.enabled:
       tracer.complete(f'pipeline.{label}.map', t_map,
@@ -200,7 +271,100 @@ class Executor:
       return local_results
     gathered = self._comm.allgather_object(local_results)
     ordered = [None] * len(tasks)
+    seen = [False] * len(tasks)
     for rank_results in gathered:
       for i, res in rank_results:
         ordered[i] = res
+        seen[i] = True
+    missing = [i for i, ok in enumerate(seen) if not ok]
+    if missing:
+      # A silent None here used to flow downstream and fail far from the
+      # cause; name the holes at the boundary instead.
+      shown = ', '.join(map(str, missing[:32]))
+      more = f' (+{len(missing) - 32} more)' if len(missing) > 32 else ''
+      raise RuntimeError(
+          f'map({label!r}) gather returned no result for {len(missing)} '
+          f'of {len(tasks)} tasks — missing global indices: {shown}{more}. '
+          'A rank likely dropped tasks or returned a truncated result '
+          'list.')
     return ordered
+
+  def _map_serial(self, fn, tasks, my_indices, label, task_name,
+                  task_hist, tasks_done, tracer, tele, local_results):
+    total = len(my_indices)
+    # Even single-worker ranks get overlapped write-back: tasks hand
+    # their Parquet writes to the ambient writer thread (Arrow releases
+    # the GIL), so encode of shard N+1 overlaps the write of shard N.
+    writer = AsyncShardWriter() if write_back_enabled() else None
+    previous = install_writer(writer)
+    try:
+      for i in my_indices:
+        gi, res, t0, dt, pid = _run_task(fn, i, tasks[i])
+        task_hist.observe(dt)
+        tasks_done.add(1)
+        tracer.complete(task_name, t0, dt, tid=pid)
+        local_results.append((gi, res))
+        if self._progress:
+          self._progress.update(label, len(local_results), total)
+      if writer is not None:
+        writer.flush()
+    except BaseException:
+      # The task error is the story; drain the writer quietly.
+      if writer is not None:
+        writer.close(raise_errors=False)
+        writer = None
+      raise
+    finally:
+      install_writer(previous)
+      if writer is not None:
+        backlog = writer.take_backlog_hwm()
+        writer.close()
+        tele.gauge('pipeline.pool.writer_backlog').set(backlog)
+
+  def _map_pooled(self, fn, tasks, my_indices, label, task_name, cost_key,
+                  task_hist, tasks_done, tracer, tele, local_results):
+    total = len(my_indices)
+    pool = self._get_pool()
+    items = []
+    for i in my_indices:
+      cost = cost_key(tasks[i], i) if cost_key is not None else i
+      items.append((i, tasks[i], cost))
+    steals = tele.counter(f'pipeline.{label}.steals')
+    idle_hist = tele.histogram(f'pipeline.{label}.worker_idle_seconds')
+    depth_gauge = tele.gauge('pipeline.pool.queue_depth')
+    done = 0
+
+    def on_result(msg):
+      nonlocal done
+      _, gi, res, terr, t0, dt, pid, wid, pos, wait = msg
+      done += 1
+      pending = total - done
+      depth_gauge.set(pending)
+      if terr is None:
+        task_hist.observe(dt)
+        tasks_done.add(1)
+        idle_hist.observe(wait)
+        # Under static stride, queue position `pos` would have belonged
+        # to worker `pos % N`; a different worker pulling it is a steal —
+        # the load-balance events the static scheduler couldn't make.
+        if pos % pool.num_workers != wid:
+          steals.add(1)
+        tracer.complete(task_name, t0, dt, tid=pid)
+        if wait > 0:
+          tracer.complete(f'pipeline.{label}.worker_idle', t0 - wait, wait,
+                          tid=pid)
+        tracer.counter('pipeline.pool.queue_depth', pending)
+        local_results.append((gi, res))
+      if self._progress:
+        self._progress.update(label, done, total)
+
+    try:
+      _, hwms = pool.run_phase(fn, items, on_result=on_result)
+    except PoolBroken:
+      # A dead worker poisons the queues; rebuild lazily on next map().
+      self._drop_pool(force=True)
+      raise
+    tele.gauge('pipeline.pool.writer_backlog').set(max(hwms) if hwms else 0)
+    # The shared queue hands results back in completion order; the
+    # contract is task order.
+    local_results.sort(key=lambda r: r[0])

@@ -46,6 +46,44 @@ def _default_compression():
       return None
 
 
+def write_shard_file(table, path, output_format='parquet',
+                     compression='default'):
+  """Write one shard file atomically (tmp in the same dir, then rename).
+
+  A preprocessor killed mid-write must never leave a truncated part file
+  that shard discovery (which matches on the final extension only) would
+  read as valid (same tmp+rename discipline as pipeline/shuffle.py). The
+  leading dot plus '.tmp' extension keeps the tmp name invisible to
+  get_all_parquets_under/get_all_txt_files_under even mid-write.
+
+  Module-level (not a closure) so it is picklable and safe to hand to an
+  ``AsyncShardWriter`` — the deferred write runs this exact function, so
+  overlapped write-back changes *when* bytes land, never *what* lands.
+  """
+  if compression == 'default':
+    compression = _default_compression()
+  out_dir = os.path.dirname(path) or '.'
+  tmp = os.path.join(out_dir, f'.{os.path.basename(path)}.tmp')
+  try:
+    if output_format == 'parquet':
+      # Dictionary encoding buys nothing on long, mostly-unique token
+      # strings, and per-page statistics are never consulted by the
+      # loader (row counts come from the footer) — both are pure
+      # writer-side cost here.
+      pq.write_table(table, tmp, compression=compression,
+                     use_dictionary=False, write_statistics=False)
+    elif output_format == 'txt':
+      with open(tmp, 'w', encoding='utf-8') as f:
+        for row in table.to_pylist():
+          f.write(repr(row) + '\n')
+    else:
+      raise ValueError(f'unknown output_format {output_format!r}')
+    os.rename(tmp, path)
+  finally:
+    if os.path.exists(tmp):
+      os.remove(tmp)
+
+
 def write_samples_partition(
     samples,
     schema,
@@ -55,6 +93,7 @@ def write_samples_partition(
     nbins=None,
     compression='default',
     output_format='parquet',
+    writer=None,
 ):
   """Write one partition of sample dicts.
 
@@ -62,7 +101,9 @@ def write_samples_partition(
   for binned output every sample must have a ``num_tokens`` entry.
   Returns a dict ``{bin_id_or_None: (path, num_samples)}``. All ``nbins``
   files are written even when empty, so the global bin-id set is always
-  contiguous (the balancer consolidates empties away).
+  contiguous (the balancer consolidates empties away). ``writer``: an
+  optional ``pool.AsyncShardWriter`` — file writes are then deferred to
+  its background thread (flushed at phase end) instead of blocking here.
   """
   cols = {
       field: pa.array([r[field] for r in samples],
@@ -77,6 +118,7 @@ def write_samples_partition(
       nbins=nbins,
       compression=compression,
       output_format=output_format,
+      writer=writer,
   )
 
 
@@ -88,6 +130,7 @@ def write_table_partition(
     nbins=None,
     compression='default',
     output_format='parquet',
+    writer=None,
 ):
   """Columnar sibling of :func:`write_samples_partition`.
 
@@ -95,37 +138,23 @@ def write_table_partition(
   column; must contain ``num_tokens`` when binned). The bin split happens
   via one stable argsort + per-bin ``Table.take`` (Arrow C++), avoiding
   any per-row Python. Returns ``{bin_id_or_None: (path, num_samples)}``.
+  With ``writer`` (a ``pool.AsyncShardWriter``), each shard write is
+  submitted to the background writer thread instead of running inline —
+  identical bytes (same :func:`write_shard_file`), just overlapped with
+  the caller's next encode; the executor flushes writers before a phase
+  completes.
   """
   if compression == 'default':
     compression = _default_compression()
   os.makedirs(out_dir, exist_ok=True)
 
   def _write(tbl, path):
-    # Write to a tmp name in out_dir, then rename: a preprocessor killed
-    # mid-write must never leave a truncated part file that shard
-    # discovery (which matches on the final extension only) would read
-    # as valid (same tmp+rename discipline as pipeline/shuffle.py). The
-    # leading dot plus '.tmp' extension keeps the tmp name invisible to
-    # get_all_parquets_under/get_all_txt_files_under even mid-write.
-    tmp = os.path.join(out_dir, f'.{os.path.basename(path)}.tmp')
-    try:
-      if output_format == 'parquet':
-        # Dictionary encoding buys nothing on long, mostly-unique token
-        # strings, and per-page statistics are never consulted by the
-        # loader (row counts come from the footer) — both are pure
-        # writer-side cost here.
-        pq.write_table(tbl, tmp, compression=compression,
-                       use_dictionary=False, write_statistics=False)
-      elif output_format == 'txt':
-        with open(tmp, 'w', encoding='utf-8') as f:
-          for row in tbl.to_pylist():
-            f.write(repr(row) + '\n')
-      else:
-        raise ValueError(f'unknown output_format {output_format!r}')
-      os.rename(tmp, path)
-    finally:
-      if os.path.exists(tmp):
-        os.remove(tmp)
+    if writer is not None:
+      writer.submit(write_shard_file, tbl, path,
+                    output_format=output_format, compression=compression)
+    else:
+      write_shard_file(tbl, path, output_format=output_format,
+                       compression=compression)
 
   ext = 'parquet' if output_format == 'parquet' else 'txt'
   if bin_size is None:

@@ -1,0 +1,373 @@
+"""Persistent work-stealing worker pool + overlapped shard write-back.
+
+The seed executor paid two structural taxes on every ``map()`` phase:
+
+  1. **Pool churn** — a fresh ``ProcessPoolExecutor`` per phase, so every
+     phase re-paid worker spawn (forkserver startup once jax is loaded)
+     and a cold per-worker tokenizer/native-encoder warmup. The reference
+     avoids exactly this with a long-lived Dask-distributed worker pool
+     (``dask_mpi.initialize``); this module is the moral equivalent: one
+     :class:`WorkerPool` per :class:`~.executor.Executor` lifetime,
+     created lazily, reused across all phases, with registered warmup
+     hooks run **once per worker per pool lifetime**.
+  2. **Static dispatch + synchronous writes** — one future per task in
+     submission order leaves a straggler tail when shards are size-skewed,
+     and each task blocked on its own Parquet write. Here every rank owns
+     a single shared task queue its workers pull from (idle workers
+     "steal" whatever is next — dynamic load balance without any
+     cross-rank coordination), tasks are enqueued in size-descending LPT
+     order by the caller, and each worker owns an
+     :class:`AsyncShardWriter` thread so the encode of task N+1 overlaps
+     the Parquet write of task N.
+
+Determinism contract: scheduling here is rank-local only. The cross-rank
+task split stays the pure ``tasks[rank::world]`` stride computed in
+``executor.py``, task outputs remain functions of ``(task, global_index)``
+alone, and the deferred writes run the identical tmp+rename
+``write_shard_file`` — so shard bytes are independent of worker count,
+queue order, and write-back timing.
+"""
+
+import multiprocessing as _mp
+import os
+import queue as _queue
+import sys
+import threading
+import time
+import traceback
+
+
+def _default_mp_context():
+  """fork is fastest, but forking a process that has initialized JAX (its
+  runtime holds locks in background threads) can deadlock the child — so
+  once ``jax`` is imported anywhere in the process, pool workers come from
+  a clean forkserver instead."""
+  if 'jax' in sys.modules and 'forkserver' in _mp.get_all_start_methods():
+    return _mp.get_context('forkserver')
+  if 'jax' in sys.modules:
+    return _mp.get_context('spawn')
+  return None  # platform default (fork on Linux)
+
+
+def write_back_enabled():
+  """Overlapped write-back is on unless ``LDDL_WRITE_BACK`` disables it."""
+  return os.environ.get('LDDL_WRITE_BACK', '').strip().lower() not in (
+      '0', 'false', 'off')
+
+
+def _write_back_depth():
+  try:
+    return max(1, int(os.environ.get('LDDL_WRITE_BACK_DEPTH', '2')))
+  except ValueError:
+    return 2
+
+
+class WriteBackError(RuntimeError):
+  """A deferred shard write failed on the background writer thread."""
+
+
+class AsyncShardWriter:
+  """Bounded background write-back: one thread draining a small job queue.
+
+  Tasks submit ``(fn, args)`` write jobs (typically
+  :func:`~.parquet_io.write_shard_file`) and continue computing; the
+  queue bound provides backpressure so at most ``max_pending`` shard
+  tables are ever held in memory. ``flush()`` blocks until every
+  submitted job has run and re-raises the first failure — callers must
+  flush before treating a phase's output as durable.
+  """
+
+  def __init__(self, max_pending=None):
+    self._q = _queue.Queue(max_pending or _write_back_depth())
+    self._err = None
+    self.backlog_hwm = 0  # max queue depth observed since last reset
+    self._thread = threading.Thread(
+        target=self._run, name='lddl-write-back', daemon=True)
+    self._thread.start()
+
+  def _run(self):
+    while True:
+      job = self._q.get()
+      if job is None:
+        self._q.task_done()
+        return
+      fn, args, kwargs = job
+      try:
+        fn(*args, **kwargs)
+      except BaseException:
+        if self._err is None:  # first failure wins; later shards still run
+          self._err = traceback.format_exc()
+      finally:
+        self._q.task_done()
+
+  def _raise_pending(self):
+    if self._err is not None:
+      raise WriteBackError(
+          'background shard write failed:\n' + self._err)
+
+  def submit(self, fn, *args, **kwargs):
+    """Enqueue one write job (blocks when ``max_pending`` are in flight)."""
+    self._raise_pending()
+    depth = self._q.qsize() + 1
+    if depth > self.backlog_hwm:
+      self.backlog_hwm = depth
+    self._q.put((fn, args, kwargs))
+
+  def flush(self):
+    """Block until all submitted jobs ran; raise on any failure."""
+    self._q.join()
+    self._raise_pending()
+
+  def take_backlog_hwm(self):
+    """Read-and-reset the high-water mark (per-phase accounting)."""
+    hwm, self.backlog_hwm = self.backlog_hwm, 0
+    return hwm
+
+  def close(self, raise_errors=True):
+    """Drain, stop the thread, and (optionally) raise pending failures."""
+    self._q.put(None)
+    self._q.join()
+    self._thread.join(timeout=30.0)
+    if raise_errors:
+      self._raise_pending()
+
+
+# The per-process "ambient" writer tasks pick up via current_writer():
+# inside a pool worker it is the worker's AsyncShardWriter (installed by
+# _worker_main); in the serial path the executor installs one around its
+# task loop; everywhere else it is None and writes stay synchronous.
+_CURRENT_WRITER = None
+
+
+def current_writer():
+  """The ambient :class:`AsyncShardWriter` for this process, or None."""
+  return _CURRENT_WRITER
+
+
+def install_writer(writer):
+  """Install ``writer`` as the ambient writer; returns the previous one."""
+  global _CURRENT_WRITER
+  previous, _CURRENT_WRITER = _CURRENT_WRITER, writer
+  return previous
+
+
+def _format_remote_error(exc):
+  return ''.join(
+      traceback.format_exception(type(exc), exc, exc.__traceback__))
+
+
+def _worker_main(worker_id, task_q, result_q, barrier, warmups):
+  """Pool worker loop: warm up once, then pull from the shared queue.
+
+  Message protocol (task_q -> worker): ``('task', fn, gi, task, pos)``,
+  ``('flush',)``, ``('call', fn)``, ``('stop',)``. Replies (result_q):
+  ``('ready', wid, pid, err)``, ``('result', gi, res, err, t0, dt, pid,
+  wid, pos, wait)``, ``('flush_ack', wid, backlog_hwm, err)``,
+  ``('call_ack', wid, err)``. ``flush``/``call`` end on the shared
+  barrier so each of the N tokens is consumed by a distinct worker.
+  """
+  err = None
+  try:
+    for fn in warmups:
+      fn()
+  except BaseException as e:  # noqa: BLE001 — report, parent decides
+    err = _format_remote_error(e)
+  writer = AsyncShardWriter() if write_back_enabled() else None
+  install_writer(writer)
+  result_q.put(('ready', worker_id, os.getpid(), err))
+  idle_t0 = time.monotonic()
+  while True:
+    msg = task_q.get()
+    wait = time.monotonic() - idle_t0
+    kind = msg[0]
+    if kind == 'task':
+      _, fn, gi, task, pos = msg
+      res, terr = None, None
+      t0 = time.monotonic()
+      try:
+        res = fn(task, gi)
+      except BaseException as e:  # noqa: BLE001
+        terr = _format_remote_error(e)
+      dt = time.monotonic() - t0
+      result_q.put(('result', gi, res, terr, t0, dt, os.getpid(),
+                    worker_id, pos, wait))
+    elif kind == 'flush':
+      ferr, hwm = None, 0
+      if writer is not None:
+        try:
+          writer.flush()
+        except BaseException as e:  # noqa: BLE001
+          ferr = _format_remote_error(e)
+        hwm = writer.take_backlog_hwm()
+      result_q.put(('flush_ack', worker_id, hwm, ferr))
+      barrier.wait()
+    elif kind == 'call':
+      cerr = None
+      try:
+        msg[1]()
+      except BaseException as e:  # noqa: BLE001
+        cerr = _format_remote_error(e)
+      result_q.put(('call_ack', worker_id, cerr))
+      barrier.wait()
+    elif kind == 'stop':
+      if writer is not None:
+        writer.close(raise_errors=False)
+      return
+    idle_t0 = time.monotonic()
+
+
+class PoolBroken(RuntimeError):
+  """A pool worker died; the pool can no longer be trusted."""
+
+
+class TaskFailed(RuntimeError):
+  """A task raised inside a pool worker (remote traceback attached)."""
+
+
+class WorkerPool:
+  """A persistent set of worker processes fed from one shared task queue.
+
+  Created once (lazily) per Executor and reused across every ``map()``
+  phase: workers stay warm — the registered warmup hooks (tokenizer +
+  native encoder) run exactly once per worker per pool lifetime, at
+  startup, and late hooks via :meth:`broadcast`. Dispatch is
+  work-stealing by construction: all workers pull from the same queue,
+  so a worker that finishes early immediately takes the next pending
+  task instead of idling behind a static stride assignment.
+  """
+
+  def __init__(self, num_workers, mp_context=None, warmups=()):
+    ctx = mp_context or _default_mp_context() or _mp.get_context()
+    self._ctx = ctx
+    self.num_workers = num_workers
+    self.start_method = getattr(ctx, '_name', None) or _mp.get_start_method()
+    self._task_q = ctx.Queue()
+    self._result_q = ctx.Queue()
+    self._barrier = ctx.Barrier(num_workers + 1)
+    self._closed = False
+    self._procs = []
+    for w in range(num_workers):
+      p = ctx.Process(
+          target=_worker_main,
+          args=(w, self._task_q, self._result_q, self._barrier,
+                tuple(warmups)),
+          name=f'lddl-pool-{w}',
+          daemon=True)
+      p.start()
+      self._procs.append(p)
+    self.worker_pids = [None] * num_workers
+    try:
+      for _ in range(num_workers):
+        msg = self._next_result()
+        if msg[0] != 'ready':
+          raise PoolBroken(f'unexpected startup message {msg[0]!r}')
+        if msg[3] is not None:
+          raise PoolBroken(
+              f'worker {msg[1]} warmup failed:\n{msg[3]}')
+        self.worker_pids[msg[1]] = msg[2]
+    except BaseException:
+      self.shutdown(force=True)
+      raise
+
+  def _next_result(self):
+    """Next message off the result queue, raising if a worker died
+    (instead of hanging forever on a queue a dead worker will never
+    feed)."""
+    while True:
+      try:
+        return self._result_q.get(timeout=1.0)
+      except _queue.Empty:
+        dead = [(p.name, p.exitcode) for p in self._procs
+                if not p.is_alive()]
+        if dead:
+          raise PoolBroken(
+              f'pool worker(s) died: {dead}; the phase cannot complete')
+
+  def _barrier_wait(self):
+    try:
+      self._barrier.wait(timeout=60.0)
+    except threading.BrokenBarrierError:
+      raise PoolBroken('pool workers failed to reach the phase barrier')
+
+  def run_phase(self, fn, items, on_result=None):
+    """Run ``fn(task, global_index)`` for every ``(gi, task, cost)``.
+
+    Tasks are enqueued in size-descending (LPT) order of ``cost`` (ties
+    broken by ascending ``gi``, so the order is deterministic) onto the
+    shared queue; idle workers steal from the head. Returns
+    ``(records, backlog_hwms)`` where each record is the raw ``result``
+    message and ``backlog_hwms`` is the per-worker write-back queue
+    high-water mark for the phase. Raises :class:`TaskFailed` /
+    :class:`WriteBackError` after the phase fully drains (so the pool
+    stays reusable even when a task fails).
+    """
+    if self._closed:
+      raise PoolBroken('pool already shut down')
+    ordered = sorted(items, key=lambda it: (-it[2], it[0]))
+    for pos, (gi, task, _cost) in enumerate(ordered):
+      self._task_q.put(('task', fn, gi, task, pos))
+    records = []
+    for _ in range(len(ordered)):
+      msg = self._next_result()
+      records.append(msg)
+      if on_result is not None:
+        on_result(msg)
+    # Flush round: exactly num_workers tokens, each consumed by a distinct
+    # worker (a worker that took one parks on the barrier and cannot take
+    # another), so every worker's write-back queue is provably drained
+    # before the phase's results are treated as durable.
+    for _ in range(self.num_workers):
+      self._task_q.put(('flush',))
+    hwms, flush_errs = [], []
+    for _ in range(self.num_workers):
+      msg = self._next_result()
+      hwms.append(msg[2])
+      if msg[3] is not None:
+        flush_errs.append(msg[3])
+    self._barrier_wait()
+    failed = sorted((m for m in records if m[3] is not None),
+                    key=lambda m: m[1])
+    if failed:
+      gi, err = failed[0][1], failed[0][3]
+      raise TaskFailed(
+          f'task (global index {gi}) failed in pool worker:\n{err}')
+    if flush_errs:
+      raise WriteBackError(
+          'deferred shard write(s) failed:\n' + '\n'.join(flush_errs))
+    return records, hwms
+
+  def broadcast(self, fn):
+    """Run ``fn()`` once on every worker (late warmup hooks)."""
+    if self._closed:
+      raise PoolBroken('pool already shut down')
+    for _ in range(self.num_workers):
+      self._task_q.put(('call', fn))
+    errs = []
+    for _ in range(self.num_workers):
+      msg = self._next_result()
+      if msg[2] is not None:
+        errs.append(msg[2])
+    self._barrier_wait()
+    if errs:
+      raise PoolBroken('worker warmup broadcast failed:\n' + '\n'.join(errs))
+
+  def shutdown(self, force=False):
+    """Stop all workers. Idempotent; ``force`` skips the polite stop."""
+    if self._closed:
+      return
+    self._closed = True
+    if not force:
+      try:
+        for _ in self._procs:
+          self._task_q.put(('stop',))
+      except (OSError, ValueError):
+        force = True
+    for p in self._procs:
+      p.join(timeout=None if force else 10.0)
+      if p.is_alive():
+        p.terminate()
+    for p in self._procs:
+      if p.is_alive():
+        p.join(timeout=10.0)
+    self._task_q.close()
+    self._result_q.close()

@@ -87,6 +87,17 @@ def _scatter_corpus_task(part_slices, idx, num_targets, spill_dir, seed,
                            delimiter=delimiter)
 
 
+def _slices_cost(part_slices, idx):
+  """LPT cost key for scatter: bytes of text the partition will read.
+  Deterministic (pure function of the partition plan), so every rank and
+  worker count derives the same enqueue order."""
+  try:
+    total = sum(int(s.end) - int(s.start) for s in part_slices)
+  except (AttributeError, TypeError):
+    return idx
+  return total if total > 0 else idx
+
+
 def shuffle_corpus(executor, corpus, spill_dir, seed, num_targets=None):
   """Shuffle a :class:`~lddl_tpu.preprocess.readers.Corpus` (honoring its
   per-partition subsampling) into ``num_targets`` on-disk partitions.
@@ -105,7 +116,7 @@ def shuffle_corpus(executor, corpus, spill_dir, seed, num_targets=None):
       sample_seed=corpus.sample_seed,
       delimiter=corpus.delimiter)
   executor.map(task, list(corpus.partitions), gather=False,
-               label='scatter')
+               label='scatter', cost_key=_slices_cost)
   return num_targets
 
 
@@ -131,5 +142,6 @@ def shuffle_lines(executor, partitions, spill_dir, seed, num_targets=None):
       seed=seed)
   # map(gather=False) ends with a barrier, so all spills are visible to all
   # ranks when this returns.
-  executor.map(task, partitions, gather=False, label='scatter')
+  executor.map(task, partitions, gather=False, label='scatter',
+               cost_key=_slices_cost)
   return num_targets

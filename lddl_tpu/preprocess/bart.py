@@ -20,6 +20,7 @@ import pyarrow as pa
 
 from ..pipeline.executor import Executor
 from ..pipeline.parquet_io import write_samples_partition
+from ..pipeline.pool import current_writer
 from ..pipeline.shuffle import gather_partition
 from ..tokenization import split_sentences
 from .common import run_shuffled
@@ -73,7 +74,8 @@ def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
       lines, cfg.target_seq_length, sentence_backend=cfg.sentence_backend)
   rows = [{'sentences': s['sentences']} for s in seqs]
   out = write_samples_partition(
-      rows, BART_SCHEMA, out_dir, tgt_idx, output_format=cfg.output_format)
+      rows, BART_SCHEMA, out_dir, tgt_idx, output_format=cfg.output_format,
+      writer=current_writer())
   return {b: n for b, (_, n) in out.items()}
 
 

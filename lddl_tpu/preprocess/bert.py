@@ -34,6 +34,7 @@ from ..core import attach_bool_arg, serialize_np_array
 from ..core.random import rng_from_key
 from ..pipeline.executor import Executor
 from ..pipeline.parquet_io import write_samples_partition, write_table_partition
+from ..pipeline.pool import current_writer
 from ..pipeline.shuffle import gather_partition
 from ..tokenization import split_sentences
 from .common import run_shuffled
@@ -457,6 +458,15 @@ def _get_tokenizer(cfg):
       backend=cfg.tokenizer_backend)
 
 
+def _warmup_worker(cfg):
+  """Persistent-pool warmup hook: build (and cache) the tokenizer in the
+  worker before its first task, so vocab load + native-encoder
+  construction are paid once per worker per pool lifetime instead of
+  inside task one of every phase."""
+  tokenizer = _get_tokenizer(cfg)
+  tokenizer.batch_tokenize(['warmup'])
+
+
 def _mask_seed(seed, tgt_idx):
   """Per-partition masking seed, independent of the pairing rng stream."""
   return int(
@@ -486,6 +496,7 @@ def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
         bin_size=cfg.bin_size,
         nbins=cfg.nbins,
         output_format=cfg.output_format,
+        writer=current_writer(),
     )
     return {b: nrows for b, (_, nrows) in out.items()}
 
@@ -517,6 +528,7 @@ def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
       bin_size=cfg.bin_size,
       nbins=cfg.nbins,
       output_format=cfg.output_format,
+      writer=current_writer(),
   )
   return {b: n for b, (_, n) in out.items()}
 
@@ -567,7 +579,9 @@ def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
       functools.partial(_process_partition, out_dir=sink_dir, cfg=cfg),
       cfg.seed,
       executor=executor,
-      num_shuffle_partitions=num_shuffle_partitions)
+      num_shuffle_partitions=num_shuffle_partitions,
+      warmup=functools.partial(_warmup_worker, cfg),
+      warmup_key=('bert-warmup', cfg))
 
 
 def attach_args(parser):
@@ -661,7 +675,8 @@ def main(args=None):
       output_format=args.output_format,
   )
   t0 = time.perf_counter()
-  counts = run(corpus, args.sink, cfg, executor=executor)
+  with executor:
+    counts = run(corpus, args.sink, cfg, executor=executor)
   if comm.rank == 0:
     total = sum(n for c in counts for n in c.values())
     print(f'preprocessed {total} samples into {len(counts)} partitions '

@@ -32,6 +32,7 @@ from ..core import attach_bool_arg
 from ..core.random import rng_from_key
 from ..pipeline.executor import Executor
 from ..pipeline.parquet_io import write_samples_partition
+from ..pipeline.pool import current_writer
 from ..pipeline.shuffle import gather_partition
 from .common import run_shuffled
 from .readers import read_code, split_id_code_docstring
@@ -185,6 +186,13 @@ def _get_tokenizer(cfg):
       backend=cfg.tokenizer_backend)
 
 
+def _warmup_worker(cfg):
+  """Persistent-pool warmup hook: cache the tokenizer in each worker
+  before its first task (see bert._warmup_worker)."""
+  tokenizer = _get_tokenizer(cfg)
+  tokenizer.batch_tokenize(['warmup'])
+
+
 def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg,
                        delimiter='\r\n'):
   del global_idx
@@ -211,6 +219,7 @@ def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg,
       bin_size=cfg.bin_size,
       nbins=cfg.nbins,
       output_format=cfg.output_format,
+      writer=current_writer(),
   )
   return {b: n for b, (_, n) in out.items()}
 
@@ -224,7 +233,9 @@ def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
                         delimiter=corpus.delimiter),
       cfg.seed,
       executor=executor,
-      num_shuffle_partitions=num_shuffle_partitions)
+      num_shuffle_partitions=num_shuffle_partitions,
+      warmup=functools.partial(_warmup_worker, cfg),
+      warmup_key=('codebert-warmup', cfg))
 
 
 def attach_args(parser):

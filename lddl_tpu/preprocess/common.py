@@ -25,26 +25,61 @@ def get_cached_tokenizer(vocab_file=None, hub_name=None, lowercase=True,
   return _TOKENIZER_CACHE[key]
 
 
+def spill_partition_bytes(spill_dir, tgt_idx, global_idx):
+  """LPT cost key for the process phase: total spilled bytes destined for
+  output partition ``tgt_idx``. Pure function of on-disk state every rank
+  shares, so all ranks derive the same ordering; falls back to the task
+  index when the partition received no spills."""
+  tgt_dir = os.path.join(spill_dir, f'tgt{tgt_idx}')
+  if not os.path.isdir(tgt_dir):
+    return global_idx
+  total = 0
+  for name in sorted(os.listdir(tgt_dir)):
+    if name.endswith('.txt'):
+      try:
+        total += os.path.getsize(os.path.join(tgt_dir, name))
+      except OSError:
+        pass
+  return total if total > 0 else global_idx
+
+
 def run_shuffled(corpus, sink_dir, process_partition, seed, executor=None,
-                 num_shuffle_partitions=None):
+                 num_shuffle_partitions=None, warmup=None, warmup_key=None):
   """Global shuffle -> ``process_partition(tgt_idx, global_idx)`` fan-out.
 
   ``process_partition`` must be a picklable callable taking
   ``(tgt_idx, global_idx, spill_dir)`` (use ``functools.partial`` to bind
-  config). Pre-cleans stale spills from a previous crashed/re-partitioned
-  run, removes the plaintext spill copy on success, and returns the
-  task-ordered result list.
+  config). ``warmup`` (optional, picklable, zero-arg) is registered on the
+  executor's persistent pool so every worker pre-loads its tokenizer /
+  native encoder once per pool lifetime — pass a stable ``warmup_key`` so
+  repeated runs on one executor don't re-broadcast it. Pre-cleans stale
+  spills from a previous crashed/re-partitioned run, removes the plaintext
+  spill copy on success, and returns the task-ordered result list. An
+  executor created here (none passed in) is closed before returning.
   """
+  owned = executor is None
   executor = executor or Executor()
-  os.makedirs(sink_dir, exist_ok=True)
-  spill_dir = os.path.join(sink_dir, '_shuffle_spill')
-  if executor.comm.rank == 0 and os.path.isdir(spill_dir):
-    shutil.rmtree(spill_dir)
-  executor.comm.barrier()
-  n = shuffle_corpus(
-      executor, corpus, spill_dir, seed, num_targets=num_shuffle_partitions)
-  task = functools.partial(process_partition, spill_dir=spill_dir)
-  results = executor.map(task, list(range(n)), label='process')
-  if executor.comm.rank == 0:
-    shutil.rmtree(spill_dir, ignore_errors=True)
-  return results
+  try:
+    if warmup is not None:
+      executor.set_warmup(warmup, key=warmup_key)
+    os.makedirs(sink_dir, exist_ok=True)
+    spill_dir = os.path.join(sink_dir, '_shuffle_spill')
+    if executor.comm.rank == 0 and os.path.isdir(spill_dir):
+      shutil.rmtree(spill_dir)
+    executor.comm.barrier()
+    n = shuffle_corpus(
+        executor, corpus, spill_dir, seed, num_targets=num_shuffle_partitions)
+    task = functools.partial(process_partition, spill_dir=spill_dir)
+    results = executor.map(
+        task, list(range(n)), label='process',
+        cost_key=functools.partial(_process_cost, spill_dir))
+    if executor.comm.rank == 0:
+      shutil.rmtree(spill_dir, ignore_errors=True)
+    return results
+  finally:
+    if owned:
+      executor.close()
+
+
+def _process_cost(spill_dir, tgt_idx, global_idx):
+  return spill_partition_bytes(spill_dir, tgt_idx, global_idx)

@@ -41,6 +41,7 @@ from ..core import attach_bool_arg
 from ..core.utils import u16_batch_binary_parts
 from ..pipeline.executor import Executor
 from ..pipeline.parquet_io import write_table_partition
+from ..pipeline.pool import current_writer
 from ..pipeline.shuffle import gather_partition
 from .common import run_shuffled
 from .readers import read_corpus, split_id_text
@@ -186,7 +187,7 @@ def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg):
     })
   out = write_table_partition(
       table, out_dir, tgt_idx, bin_size=cfg.bin_size, nbins=cfg.nbins,
-      output_format=cfg.output_format)
+      output_format=cfg.output_format, writer=current_writer())
   return {b: nrows for b, (_, nrows) in out.items()}
 
 

@@ -269,6 +269,81 @@ def test_lda005_ignores_numpy_broadcast():
 
 
 # ---------------------------------------------------------------------------
+# LDA006: worker-pool churn
+
+
+def test_lda006_flags_pool_in_loop():
+  assert run("""
+      import concurrent.futures as cf
+      import multiprocessing as mp
+      for chunk in chunks:
+        with cf.ProcessPoolExecutor(max_workers=4) as pool:
+          pool.map(fn, chunk)
+      while pending:
+        p = mp.Pool(2)
+      """) == ['LDA006', 'LDA006']
+
+
+def test_lda006_flags_pool_per_call_method():
+  assert run("""
+      import concurrent.futures as cf
+      class Executor:
+        def map(self, fn, tasks):
+          with cf.ProcessPoolExecutor(max_workers=2) as pool:
+            return list(pool.map(fn, tasks))
+      """) == ['LDA006']
+
+
+def test_lda006_clean_for_owned_or_one_shot_pools():
+  assert run("""
+      import concurrent.futures as cf
+      import multiprocessing as mp
+
+      def run_once(items):
+        # plain function: one pool per top-level invocation is a lifetime
+        ctx = mp.get_context('forkserver')
+        with ctx.Pool(4) as pool:
+          return pool.map(work, items)
+
+      class Owner:
+        def __init__(self):
+          self._pool = cf.ProcessPoolExecutor(max_workers=4)
+
+        def lazy(self):
+          self._pool = cf.ProcessPoolExecutor(max_workers=4)
+          return self._pool
+      """) == []
+
+
+def test_lda006_ignores_unrelated_pool_classes():
+  assert run("""
+      from mylib import Pool
+      class Builder:
+        def build(self):
+          return Pool()
+      """) == []
+
+
+def test_lda006_pragma_suppresses():
+  findings = run_findings("""
+      import multiprocessing as mp
+      for s in shards:
+        # lddl: noqa[LDA006] one shard per container, pool dies with it
+        pool = mp.Pool(1)
+      """)
+  assert [f.rule_id for f in findings] == ['LDA006']
+  assert findings[0].suppressed
+
+
+def test_lda006_exempt_in_tests():
+  assert run("""
+      import concurrent.futures as cf
+      for case in cases:
+        pool = cf.ThreadPoolExecutor(1)
+      """, path='tests/test_something.py') == []
+
+
+# ---------------------------------------------------------------------------
 # Engine / pragmas / CLI
 
 

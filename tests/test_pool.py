@@ -1,0 +1,257 @@
+"""Persistent work-stealing scheduler tests: result/byte identity across
+worker counts and submission orders, LPT straggler behavior, warmup
+once-per-pool-lifetime semantics, overlapped write-back, and the
+gather-hole error path."""
+
+import functools
+import json
+import os
+import time
+
+import pyarrow as pa
+import pytest
+
+from lddl_tpu.pipeline import Executor
+from lddl_tpu.pipeline.pool import (AsyncShardWriter, WriteBackError,
+                                    current_writer, install_writer)
+from lddl_tpu.pipeline.parquet_io import write_shard_file
+
+
+def _double(task, idx):
+  return task * 2
+
+
+def _mix(task, idx):
+  # Depends on both task and global index — catches any scheduler that
+  # delivers the wrong (task, index) pairing under reordering.
+  return task * 100 + idx
+
+
+def _sleep_task(task, idx):
+  time.sleep(task)
+  return idx
+
+
+def _boom(task, idx):
+  if idx == 2:
+    raise ValueError(f'task {task} exploded')
+  return task
+
+
+def _touch_pid_file(dir_path):
+  # One append per invocation: file-per-pid with one char per warmup run.
+  with open(os.path.join(dir_path, str(os.getpid())), 'a') as f:
+    f.write('x')
+
+
+class TestSchedulingIdentity:
+
+  def test_results_identical_across_worker_counts(self):
+    tasks = list(range(17))
+    expected = [_mix(t, i) for i, t in enumerate(tasks)]
+    for workers in (1, 4):
+      with Executor(num_local_workers=workers) as ex:
+        assert ex.map(_mix, tasks) == expected, f'workers={workers}'
+
+  def test_results_identical_under_shuffled_submission_order(self):
+    tasks = list(range(17))
+    expected = [_mix(t, i) for i, t in enumerate(tasks)]
+    # Different cost keys = different LPT enqueue orders = different
+    # stealing interleavings; results must not move.
+    costs = [
+        lambda task, i: i,
+        lambda task, i: -i,
+        lambda task, i: (i * 7919) % 17,
+    ]
+    with Executor(num_local_workers=4) as ex:
+      for ck in costs:
+        assert ex.map(_mix, tasks, cost_key=ck) == expected
+
+  def test_pool_survives_task_failure_and_reports_index(self):
+    with Executor(num_local_workers=2) as ex:
+      with pytest.raises(RuntimeError, match='global index 2'):
+        ex.map(_boom, [10, 11, 12, 13])
+      # The phase drained cleanly, so the same pool keeps working.
+      assert ex.map(_double, [1, 2, 3, 4]) == [2, 4, 6, 8]
+
+  def test_serial_task_failure_propagates(self):
+    with Executor(num_local_workers=1) as ex:
+      with pytest.raises(ValueError, match='exploded'):
+        ex.map(_boom, [10, 11, 12, 13])
+
+
+class TestStragglerScheduling:
+
+  def test_lpt_with_stealing_beats_worst_case_order(self):
+    # One 0.5 s straggler plus nine 0.05 s tasks on four workers. LPT
+    # starts the straggler first (makespan ~= its own length); the
+    # reversed order starts it last (makespan ~= shorts + straggler).
+    # Sleeps overlap even on one core, so the contrast survives a
+    # single-CPU CI box; the margin is generous to stay slow-safe.
+    durations = [0.5] + [0.05] * 9
+    with Executor(num_local_workers=4) as ex:
+      ex.map(_double, [0] * 8)  # pool spin-up outside the timed region
+
+      t0 = time.perf_counter()
+      ex.map(_sleep_task, durations, cost_key=lambda d, i: -d)
+      worst = time.perf_counter() - t0
+
+      t0 = time.perf_counter()
+      ex.map(_sleep_task, durations, cost_key=lambda d, i: d)
+      lpt = time.perf_counter() - t0
+    assert lpt <= worst - 0.05, (lpt, worst)
+
+
+class TestPoolPersistence:
+
+  def test_warmup_runs_once_per_worker_per_lifetime(self, tmp_path):
+    marks = tmp_path / 'marks'
+    marks.mkdir()
+    with Executor(num_local_workers=3) as ex:
+      ex.set_warmup(functools.partial(_touch_pid_file, str(marks)),
+                    key='touch')
+      # Re-registration under the same key must be a no-op.
+      ex.set_warmup(functools.partial(_touch_pid_file, str(marks)),
+                    key='touch')
+      ex.map(_double, list(range(6)))
+      ex.map(_double, list(range(6)))  # second phase: same warm pool
+      files = sorted(os.listdir(str(marks)))
+      assert len(files) == 3  # one file per worker pid
+      for name in files:
+        assert (marks / name).read_text() == 'x'  # exactly once each
+
+  def test_late_warmup_broadcasts_to_live_pool(self, tmp_path):
+    early = tmp_path / 'early'
+    late = tmp_path / 'late'
+    early.mkdir()
+    late.mkdir()
+    with Executor(num_local_workers=2) as ex:
+      ex.set_warmup(functools.partial(_touch_pid_file, str(early)),
+                    key='early')
+      ex.map(_double, list(range(4)))  # creates the pool
+      ex.set_warmup(functools.partial(_touch_pid_file, str(late)),
+                    key='late')
+      ex.map(_double, list(range(4)))
+      for d in (early, late):
+        files = sorted(os.listdir(str(d)))
+        assert len(files) == 2
+        assert all((d / n).read_text() == 'x' for n in files)
+
+  def test_close_is_idempotent_and_context_manager_tears_down(self):
+    ex = Executor(num_local_workers=2)
+    ex.map(_double, list(range(4)))
+    pool = ex._pool
+    assert pool is not None
+    ex.close()
+    ex.close()
+    assert ex._pool is None
+    assert all(not p.is_alive() for p in pool._procs)
+
+
+class TestAsyncShardWriter:
+
+  def test_deferred_writes_land_and_are_identical(self, tmp_path):
+    table = pa.table({'A': pa.array(['a', 'b']),
+                      'num_tokens': pa.array([3, 4], type=pa.uint16())})
+    sync_path = str(tmp_path / 'sync.parquet')
+    async_path = str(tmp_path / 'async.parquet')
+    write_shard_file(table, sync_path)
+    w = AsyncShardWriter()
+    w.submit(write_shard_file, table, async_path)
+    w.flush()
+    w.close()
+    with open(sync_path, 'rb') as f1, open(async_path, 'rb') as f2:
+      assert f1.read() == f2.read()
+
+  def test_background_failure_surfaces_on_flush(self, tmp_path):
+    table = pa.table({'A': pa.array(['a'])})
+    w = AsyncShardWriter()
+    w.submit(write_shard_file, table, str(tmp_path / 'no' / 'dir' / 'x.pq'))
+    with pytest.raises(WriteBackError):
+      w.flush()
+    w.close(raise_errors=False)
+
+  def test_install_writer_scopes_the_ambient_writer(self):
+    assert current_writer() is None
+    w = AsyncShardWriter()
+    prev = install_writer(w)
+    try:
+      assert current_writer() is w
+    finally:
+      install_writer(prev)
+      w.close()
+    assert current_writer() is None
+
+
+class _TruncatedGatherComm:
+  """Two-rank world where rank 1's results never arrive (rank 0 view)."""
+  rank = 0
+  world_size = 2
+
+  def barrier(self):
+    pass
+
+  def allgather_object(self, obj):
+    return [obj, []]
+
+  def broadcast_object(self, obj, root=0):
+    return obj
+
+
+def test_gather_hole_raises_with_missing_indices():
+  ex = Executor(comm=_TruncatedGatherComm(), num_local_workers=1)
+  with pytest.raises(RuntimeError) as ei:
+    ex.map(_double, [10, 11, 12, 13], label='holey')
+  msg = str(ei.value)
+  assert 'missing global indices: 1, 3' in msg and 'holey' in msg
+
+
+class TestPreprocessByteIdentity:
+
+  def _run(self, tmp_corpus, tiny_vocab, sink, workers):
+    from lddl_tpu.preprocess import bert
+    from lddl_tpu.preprocess.readers import read_corpus
+    cfg = bert.BertPretrainConfig(
+        vocab_file=tiny_vocab,
+        target_seq_length=32,
+        duplicate_factor=2,
+        masking=True,
+        mask_backend='host',
+        bin_size=8,
+        seed=42,
+        sentence_backend='rules',
+    )
+    corpus = read_corpus(tmp_corpus, num_blocks=6, sample_ratio=1.0)
+    with Executor(num_local_workers=workers) as ex:
+      counts = bert.run(corpus, sink, cfg, executor=ex)
+    return counts
+
+  def test_shards_byte_identical_across_worker_counts(
+      self, tmp_corpus, tiny_vocab, tmp_path):
+    outputs = {}
+    for workers in (1, 2):
+      sink = str(tmp_path / f'sink_w{workers}')
+      counts = self._run(tmp_corpus, tiny_vocab, sink, workers)
+      shards = {}
+      for name in sorted(os.listdir(sink)):
+        with open(os.path.join(sink, name), 'rb') as f:
+          shards[name] = f.read()
+      outputs[workers] = (counts, shards)
+    counts1, shards1 = outputs[1]
+    counts2, shards2 = outputs[2]
+    assert counts1 == counts2
+    assert sorted(shards1) == sorted(shards2)
+    for name in shards1:
+      assert shards1[name] == shards2[name], f'shard {name} differs'
+
+
+def test_progress_final_record_marks_complete(tmp_path, monkeypatch):
+  status = tmp_path / 'status'
+  monkeypatch.setenv('LDDL_PROGRESS', str(status))
+  with Executor(num_local_workers=2) as ex:
+    ex.map(_double, list(range(6)), label='phase-z')
+  payload = json.loads((status / 'lddl_status.rank0.json').read_text())
+  assert payload['phase'] == 'phase-z'
+  assert payload['complete'] is True
+  assert payload['workers'] == 2
+  assert payload['done'] == payload['total'] == 6
