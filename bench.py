@@ -141,8 +141,10 @@ def main():
     import jax
     num_chips = max(1, len(jax.devices()))
 
+    from lddl_tpu.loader.workers import _resolve_transport, _resolve_zero_copy
     from lddl_tpu.pipeline.executor import Executor
     from lddl_tpu.preprocess.bert import BertPretrainConfig, run
+    from lddl_tpu.preprocess.common import native_columnar_enabled
     from lddl_tpu.preprocess.readers import read_corpus
 
     import dataclasses
@@ -225,6 +227,12 @@ def main():
         # method, LPT+stealing, async write-back) — a BENCH line is not
         # comparable across scheduler configs without this.
         'scheduler': executor.scheduler_info(),
+        # Feed-path knobs in effect (loader batch transport, zero-copy slot
+        # views, fused native columnar shard assembly) — same
+        # comparability rule as 'scheduler'.
+        'transport': _resolve_transport(None),
+        'zero_copy': _resolve_zero_copy(None),
+        'native_columnar': native_columnar_enabled(),
     }
     result.update(_telemetry_artifacts())
     result.update(_lint_status())

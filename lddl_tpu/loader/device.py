@@ -22,9 +22,28 @@ import queue
 import threading
 
 import jax
-from jax.sharding import NamedSharding
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
 
 from ..telemetry.trace import get_tracer
+
+_DEFAULT_MESH = None
+
+
+def _default_batch_mesh():
+  """A one-axis ``data`` mesh over this process's devices.
+
+  ``mesh=None`` callers of :func:`prefetch_to_device` still get the
+  canonical batch-dim ``NamedSharding`` placement (the classic
+  ``Mesh(devices, ('batch',))`` + ``P('batch')`` pattern) instead of a
+  whole-batch ``device_put`` onto device 0 — on a multi-device host the
+  batch dim is spread over the chips, on one device it degenerates to
+  the old placement.
+  """
+  global _DEFAULT_MESH
+  if _DEFAULT_MESH is None:
+    _DEFAULT_MESH = Mesh(np.asarray(jax.local_devices()), ('data',))
+  return _DEFAULT_MESH
 
 
 def make_global_batch(batch, mesh, data_axis=None, seq_axis=None):
@@ -49,18 +68,34 @@ def make_global_batch(batch, mesh, data_axis=None, seq_axis=None):
 
 
 def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
-                       size=2):
+                       size=2, donate=True):
   """Yield device-resident batches, keeping up to ``size`` in flight.
 
   ``iterator`` yields numpy batch dicts (or micro-batch lists, which are
-  transferred element-wise). With ``mesh=None`` batches are placed whole
-  on the default device. ``data_axis``/``seq_axis`` forward to
-  :func:`make_global_batch`.
+  transferred element-wise). ``data_axis``/``seq_axis`` forward to
+  :func:`make_global_batch`. With ``mesh=None`` batch dicts are placed
+  with the same canonical batch-dim ``NamedSharding`` over a default
+  one-axis mesh of the local devices (:func:`_default_batch_mesh`), so
+  every path produces mesh-addressable global arrays; non-dict items
+  (and batch dims the local device count does not divide) fall back to a
+  plain ``device_put``.
 
-  This consumption pattern satisfies the loader's ``zero_copy=True``
-  contract (:mod:`.workers`): the producer thread transfers each batch
-  to device *before* pulling the next one from ``iterator``, so a
-  shared-memory view is always consumed while its slot is still held.
+  Double buffering: the producer thread transfers batch ``k+1`` while
+  the caller's step consumes batch ``k`` (the ``train.h2d`` trace spans
+  it emits overlap the main thread's compute spans). This consumption
+  pattern satisfies the loader's ``zero_copy=True`` contract
+  (:mod:`.workers`): each batch is transferred to device *before* the
+  next one is pulled from ``iterator``, so a shared-memory view is
+  always consumed while its slot is still held.
+
+  Donation (``donate=True``): pulling batch ``k+1`` deletes batch
+  ``k``'s device buffers, so steady-state HBM holds exactly the
+  in-flight transfer plus the batch being consumed — the same
+  valid-until-the-next-pull lifetime the zero-copy slot views have on
+  the host side. Keep a batch alive across pulls (or pass
+  ``donate=False``) only if you re-read it after stepping; the train
+  loop blocks on the step's output before pulling, so in-flight
+  executions are never affected (deletion waits on XLA usage holds).
   """
 
   def _put(item):
@@ -69,6 +104,13 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
     if mesh is not None:
       return make_global_batch(item, mesh, data_axis=data_axis,
                                seq_axis=seq_axis)
+    if isinstance(item, dict):
+      default = _default_batch_mesh()
+      n = default.devices.size
+      if all(getattr(v, 'ndim', 0) and v.shape[0] % n == 0
+             for v in item.values()):
+        return make_global_batch(item, default, data_axis=data_axis,
+                                 seq_axis=seq_axis)
     return jax.device_put(item)
 
   q = queue.Queue(maxsize=size)
@@ -113,12 +155,38 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
           raise err[0]
         return
       yield item
+      if donate:
+        # The consumer just asked for the next batch: the previous one's
+        # device buffers are dead by contract (see docstring). Deletion
+        # defers to XLA usage holds, so a still-executing step that read
+        # this batch finishes before the memory is actually freed.
+        _delete_device_batch(item)
   finally:
     stop.set()
     # Serialize with the producer: after close() returns, the source
     # iterator is guaranteed quiescent (it may be mid-pull right now, e.g.
     # finishing an epoch and mutating loader state).
     t.join()
+
+
+def _delete_device_batch(item):
+  """Free a yielded batch's device buffers (donation); tolerates leaves a
+  jitted step already donated."""
+  if isinstance(item, (list, tuple)):
+    for x in item:
+      _delete_device_batch(x)
+    return
+  if isinstance(item, dict):
+    for x in item.values():
+      _delete_device_batch(x)
+    return
+  delete = getattr(item, 'delete', None)
+  if delete is None:
+    return
+  is_deleted = getattr(item, 'is_deleted', None)
+  if is_deleted is not None and is_deleted():
+    return
+  delete()
 
 
 class SeqlenAwarePrefetcher:
@@ -133,6 +201,19 @@ class SeqlenAwarePrefetcher:
     self._it = iter(loader_iter)
     self._seqlen_of = seqlen_of_batch
     self._pending = collections.deque()
+
+  def close(self):
+    """Close the wrapped iterator and drop the lookahead buffer.
+
+    Abandoning a :func:`prefetch_to_device` stream mid-epoch without this
+    leaks its producer thread (and the device batches it holds): generator
+    ``close()`` only runs when the *generator* is dropped, and this wrapper
+    kept a reference to it.
+    """
+    self._pending.clear()
+    close = getattr(self._it, 'close', None)
+    if close is not None:
+      close()
 
   def next_seqlen(self):
     if not self._pending:

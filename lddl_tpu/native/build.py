@@ -96,6 +96,19 @@ def load_library():
       c.c_char_p, c.c_int64, c.POINTER(c.c_int32)
   ]
   lib.lddl_native_abi_version.restype = c.c_int64
+  lib.lddl_columnar_sizes.restype = c.c_int64
+  lib.lddl_columnar_sizes.argtypes = [
+      c.c_void_p, c.c_int32, c.POINTER(c.c_void_p), c.POINTER(c.c_void_p),
+      c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.POINTER(c.c_int64),
+      c.c_int64, c.POINTER(c.c_int64)
+  ]
+  lib.lddl_columnar_emit.restype = c.c_int64
+  lib.lddl_columnar_emit.argtypes = [
+      c.c_void_p, c.c_int32, c.POINTER(c.c_void_p), c.POINTER(c.c_void_p),
+      c.POINTER(c.c_int64), c.POINTER(c.c_void_p), c.POINTER(c.c_void_p),
+      c.POINTER(c.c_int64), c.POINTER(c.c_uint16), c.POINTER(c.c_int64),
+      c.c_int64, c.POINTER(c.c_int64), c.c_char_p, c.c_int32
+  ]
   lib.lddl_plan_pairs.restype = c.c_int64
   lib.lddl_plan_pairs.argtypes = [
       c.POINTER(c.c_int64), c.POINTER(c.c_int64), c.c_int64,

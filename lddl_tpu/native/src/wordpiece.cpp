@@ -559,6 +559,95 @@ struct ThreadSlice {
   std::vector<int64_t> seq_owner;   // which input text produced it (docs mode)
 };
 
+// Shared decode loop: id ranges -> Arrow string-column buffers. Returns
+// total data bytes, -1 on cap overflow, -2 past the int32 offset limit.
+// (Body of lddl_decode_join, reused by the fused columnar emitter.)
+int64_t decode_join_impl(const Model& m, const int32_t* ids,
+                         const int64_t* offsets, int64_t n_seqs,
+                         char* out_data, int64_t cap_data,
+                         int32_t* out_offsets) {
+  const int32_t nvocab = static_cast<int32_t>(m.tokens.size());
+  const char* arena = m.decode_arena.data();
+  const int32_t* lens = m.decode_lens.data();
+  constexpr int32_t kStride = Model::kDecodeStride;
+  int64_t pos = 0;
+  out_offsets[0] = 0;
+  for (int64_t s = 0; s < n_seqs; ++s) {
+    for (int64_t k = offsets[s]; k < offsets[s + 1]; ++k) {
+      const int32_t id = ids[k];
+      const bool first = (k == offsets[s]);
+      if (id >= 0 && id < nvocab && lens[id] < kStride - 1 &&
+          pos + kStride + 1 <= cap_data) {
+        // Hot path: one unconditional fixed-width copy of the arena slot
+        // (' ' + token, zero-padded); the advance truncates the padding.
+        // First-of-sequence reads from slot+1 to skip the space (the
+        // trailing arena pad byte makes the over-read safe).
+        std::memcpy(out_data + pos,
+                    arena + static_cast<size_t>(id) * kStride + (first ? 1 : 0),
+                    kStride);
+        pos += lens[id] + (first ? 0 : 1);
+      } else {
+        // Exact path: long/invalid ids, or too close to the buffer end
+        // for the wide store (callers leave slack, so this is rare).
+        std::string_view tok = (id >= 0 && id < nvocab)
+                                   ? m.tokens[id]
+                                   : std::string_view("[UNK]");
+        int64_t need = static_cast<int64_t>(tok.size()) + (first ? 0 : 1);
+        if (pos + need > cap_data) return -1;
+        if (!first) out_data[pos++] = ' ';
+        std::memcpy(out_data + pos, tok.data(), tok.size());
+        pos += static_cast<int64_t>(tok.size());
+      }
+    }
+    // Arrow string offsets are int32; joined output past 2 GiB must fail
+    // loudly (callers split the batch), never wrap into corrupt offsets.
+    if (pos > INT32_MAX) return -2;
+    out_offsets[s + 1] = static_cast<int32_t>(pos);
+  }
+  return pos;
+}
+
+// Exact joined-output byte count for one column of id ranges (token byte
+// lengths + one separator between tokens of a sequence). The caller adds
+// the wide-store slack itself.
+int64_t decode_join_size(const Model& m, const int32_t* ids,
+                         const int64_t* offsets, int64_t n_seqs) {
+  const int32_t nvocab = static_cast<int32_t>(m.tokens.size());
+  const int32_t* lens = m.decode_lens.data();
+  int64_t total = 0;
+  const int64_t n_ids = offsets[n_seqs];
+  for (int64_t k = 0; k < n_ids; ++k) {
+    const int32_t id = ids[k];
+    total += (id >= 0 && id < nvocab) ? lens[id] : 5;  // '[UNK]'
+  }
+  for (int64_t s = 0; s < n_seqs; ++s) {
+    const int64_t cnt = offsets[s + 1] - offsets[s];
+    if (cnt > 1) total += cnt - 1;
+  }
+  return total;
+}
+
+// The exact .npy v1.0 header np.save writes for a 1-D '<u2' array of n
+// elements (mirror of core/utils._npy_header — the fused positions
+// column must be byte-identical to the numpy framing path). Writes into
+// buf (>= 192 bytes is always enough) and returns the header length.
+int64_t npy_header_u2(int64_t n, char* buf) {
+  char body[96];
+  int len0 = std::snprintf(
+      body, sizeof(body),
+      "{'descr': '<u2', 'fortran_order': False, 'shape': (%lld,), }",
+      static_cast<long long>(n));
+  int64_t pad = ((-(10 + len0 + 1)) % 64 + 64) % 64;
+  int64_t body_len = len0 + pad + 1;
+  std::memcpy(buf, "\x93NUMPY\x01\x00", 8);
+  buf[8] = static_cast<char>(body_len & 0xFF);
+  buf[9] = static_cast<char>((body_len >> 8) & 0xFF);
+  std::memcpy(buf + 10, body, len0);
+  std::memset(buf + 10 + len0, ' ', pad);
+  buf[10 + len0 + pad] = '\n';
+  return 10 + body_len;
+}
+
 void run_threads(int64_t n_items, int nthreads,
                  const std::function<void(int64_t, int64_t, int)>& body) {
   if (nthreads <= 1 || n_items <= 1) {
@@ -754,47 +843,93 @@ int64_t lddl_decode_join(void* model, const int32_t* ids,
                          char* out_data, int64_t cap_data,
                          int32_t* out_offsets) {
   const Model& m = *static_cast<Model*>(model);
-  const int32_t nvocab = static_cast<int32_t>(m.tokens.size());
-  const char* arena = m.decode_arena.data();
-  const int32_t* lens = m.decode_lens.data();
-  constexpr int32_t kStride = Model::kDecodeStride;
-  int64_t pos = 0;
-  out_offsets[0] = 0;
-  for (int64_t s = 0; s < n_seqs; ++s) {
-    for (int64_t k = offsets[s]; k < offsets[s + 1]; ++k) {
-      const int32_t id = ids[k];
-      const bool first = (k == offsets[s]);
-      if (id >= 0 && id < nvocab && lens[id] < kStride - 1 &&
-          pos + kStride + 1 <= cap_data) {
-        // Hot path: one unconditional fixed-width copy of the arena slot
-        // (' ' + token, zero-padded); the advance truncates the padding.
-        // First-of-sequence reads from slot+1 to skip the space (the
-        // trailing arena pad byte makes the over-read safe).
-        std::memcpy(out_data + pos,
-                    arena + static_cast<size_t>(id) * kStride + (first ? 1 : 0),
-                    kStride);
-        pos += lens[id] + (first ? 0 : 1);
-      } else {
-        // Exact path: long/invalid ids, or too close to the buffer end
-        // for the wide store (callers leave slack, so this is rare).
-        std::string_view tok = (id >= 0 && id < nvocab)
-                                   ? m.tokens[id]
-                                   : std::string_view("[UNK]");
-        int64_t need = static_cast<int64_t>(tok.size()) + (first ? 0 : 1);
-        if (pos + need > cap_data) return -1;
-        if (!first) out_data[pos++] = ' ';
-        std::memcpy(out_data + pos, tok.data(), tok.size());
-        pos += static_cast<int64_t>(tok.size());
-      }
-    }
-    // Arrow string offsets are int32; joined output past 2 GiB must fail
-    // loudly (callers split the batch), never wrap into corrupt offsets.
-    if (pos > INT32_MAX) return -2;
-    out_offsets[s + 1] = static_cast<int32_t>(pos);
-  }
-  return pos;
+  return decode_join_impl(m, ids, offsets, n_seqs, out_data, cap_data,
+                          out_offsets);
 }
 
-int64_t lddl_native_abi_version() { return 3; }
+// ------------------------------------------------- fused columnar emit
+// One sizes pass + one emit pass build every Arrow column of a shard
+// directly from token ids: up to `ncols` string columns (per-column ids +
+// int64[n+1] offsets) and optionally one npy-framed uint16 binary column
+// (masked_lm_positions). This replaces, per column, the Python-side
+// capacity LUT pass, the decode call, and the vectorized-numpy npy
+// framing — all output bytes are identical to those paths.
+
+// Sizes: out_caps[c] = exact joined bytes of column c plus wide-store
+// slack (kDecodeStride + a final-token pad, rounded to 48 to match the
+// Python caller's historical slack). When pos_offs is non-null,
+// out_pos_boffs (int64[pos_n+1]) receives the npy-framed row byte
+// offsets. Returns 0.
+int64_t lddl_columnar_sizes(void* model, int32_t ncols,
+                            const int32_t* const* ids,
+                            const int64_t* const* offs, const int64_t* ns,
+                            int64_t* out_caps, const int64_t* pos_offs,
+                            int64_t pos_n, int64_t* out_pos_boffs) {
+  const Model& m = *static_cast<Model*>(model);
+  for (int32_t c = 0; c < ncols; ++c)
+    out_caps[c] = decode_join_size(m, ids[c], offs[c], ns[c]) + 48;
+  if (pos_offs != nullptr && out_pos_boffs != nullptr) {
+    char hdr[192];
+    int64_t prev_cnt = -1, prev_hdr = 0;
+    out_pos_boffs[0] = 0;
+    for (int64_t i = 0; i < pos_n; ++i) {
+      const int64_t cnt = pos_offs[i + 1] - pos_offs[i];
+      if (cnt != prev_cnt) {
+        prev_hdr = npy_header_u2(cnt, hdr);
+        prev_cnt = cnt;
+      }
+      out_pos_boffs[i + 1] = out_pos_boffs[i] + prev_hdr + 2 * cnt;
+    }
+  }
+  return 0;
+}
+
+// Emit: fill each column's (int32[n+1] offsets, data) buffers and, when
+// pos_vals is non-null, the positions binary data (headers + raw
+// little-endian uint16 payloads at the boffs computed by the sizes
+// pass). Column tasks run on up to `nthreads` threads. Returns 0, or the
+// first column's negative rc (-1 capacity, -2 int32 offset overflow).
+int64_t lddl_columnar_emit(void* model, int32_t ncols,
+                           const int32_t* const* ids,
+                           const int64_t* const* offs, const int64_t* ns,
+                           int32_t* const* out_offs, char* const* out_data,
+                           const int64_t* caps, const uint16_t* pos_vals,
+                           const int64_t* pos_offs, int64_t pos_n,
+                           const int64_t* pos_boffs, char* pos_data,
+                           int32_t nthreads) {
+  const Model& m = *static_cast<Model*>(model);
+  const int64_t n_tasks = ncols + (pos_vals != nullptr ? 1 : 0);
+  std::vector<int64_t> rc(n_tasks, 0);
+  auto body = [&](int64_t lo, int64_t hi, int t) {
+    (void)t;
+    for (int64_t task = lo; task < hi; ++task) {
+      if (task < ncols) {
+        int64_t r = decode_join_impl(m, ids[task], offs[task], ns[task],
+                                     out_data[task], caps[task],
+                                     out_offs[task]);
+        rc[task] = r < 0 ? r : 0;
+      } else {
+        char hdr[192];
+        int64_t prev_cnt = -1, prev_hdr = 0;
+        for (int64_t i = 0; i < pos_n; ++i) {
+          const int64_t cnt = pos_offs[i + 1] - pos_offs[i];
+          if (cnt != prev_cnt) {
+            prev_hdr = npy_header_u2(cnt, hdr);
+            prev_cnt = cnt;
+          }
+          char* row = pos_data + pos_boffs[i];
+          std::memcpy(row, hdr, prev_hdr);
+          std::memcpy(row + prev_hdr, pos_vals + pos_offs[i], 2 * cnt);
+        }
+      }
+    }
+  };
+  run_threads(n_tasks, nthreads, body);
+  for (int64_t r : rc)
+    if (r < 0) return r;
+  return 0;
+}
+
+int64_t lddl_native_abi_version() { return 4; }
 
 }  // extern "C"

@@ -187,6 +187,80 @@ class NativeWordPiece:
       raise RuntimeError('native decode overflow (internal capacity bug)')
     return out_offsets, out_data[:total]
 
+  def columnar_emit(self, columns, positions=None):
+    """Fused Arrow-column build: many string columns (and optionally the
+    npy-framed uint16 positions binary column) in one native round trip.
+
+    ``columns`` is a sequence of ``(flat_ids, offsets)`` pairs (the
+    :meth:`encode_batch_ids` representation); ``positions`` is an optional
+    ``(values_u16, offsets)`` pair. Returns ``(string_parts, pos_parts)``
+    where ``string_parts[i]`` is ``(out_offsets int32[n+1], data uint8)``
+    — feed into ``pyarrow.StringArray.from_buffers`` — and ``pos_parts``
+    is ``(boffs int64[n+1], data uint8)`` matching
+    :func:`lddl_tpu.core.utils.u16_batch_binary_parts` byte-for-byte
+    (``None`` when ``positions`` is ``None``).
+
+    Versus per-column :meth:`decode_join_buffers` this skips the numpy
+    capacity-LUT pass (sizes are computed natively, exactly) and the
+    vectorized-numpy npy framing; output bytes are identical.
+    """
+    import ctypes as c
+    cols = [(np.ascontiguousarray(ids, dtype=np.int32),
+             np.ascontiguousarray(offs, dtype=np.int64))
+            for ids, offs in columns]
+    ncols = len(cols)
+    ids_p = (c.c_void_p * max(ncols, 1))(
+        *[a.ctypes.data for a, _ in cols] or [None])
+    offs_p = (c.c_void_p * max(ncols, 1))(
+        *[o.ctypes.data for _, o in cols] or [None])
+    ns = np.array([len(o) - 1 for _, o in cols] or [0], dtype=np.int64)
+    caps = np.zeros(max(ncols, 1), dtype=np.int64)
+    if positions is not None:
+      pos_vals = np.ascontiguousarray(positions[0], dtype='<u2')
+      pos_offs = np.ascontiguousarray(positions[1], dtype=np.int64)
+      if int(pos_offs[0]) != 0 or int(pos_offs[-1]) != len(pos_vals):
+        # Offsets may describe a sub-span of values (mirror of
+        # u16_batch_binary_parts' normalization).
+        pos_vals = np.ascontiguousarray(pos_vals[pos_offs[0]:pos_offs[-1]])
+        pos_offs = pos_offs - pos_offs[0]
+      pos_n = len(pos_offs) - 1
+      pos_boffs = np.zeros(pos_n + 1, dtype=np.int64)
+      pos_offs_p = pos_offs.ctypes.data_as(_i64p)
+      pos_boffs_p = pos_boffs.ctypes.data_as(_i64p)
+    else:
+      pos_vals = pos_offs = pos_boffs = None
+      pos_n = 0
+      pos_offs_p = pos_boffs_p = None
+    self._lib.lddl_columnar_sizes(
+        self._model, ncols, ids_p, offs_p, ns.ctypes.data_as(_i64p),
+        caps.ctypes.data_as(_i64p), pos_offs_p, pos_n, pos_boffs_p)
+    out = [(np.empty(int(ns[i]) + 1, dtype=np.int32),
+            np.empty(int(caps[i]), dtype=np.uint8)) for i in range(ncols)]
+    out_offs_p = (c.c_void_p * max(ncols, 1))(
+        *[oo.ctypes.data for oo, _ in out] or [None])
+    out_data_p = (c.c_void_p * max(ncols, 1))(
+        *[od.ctypes.data for _, od in out] or [None])
+    if positions is not None:
+      pos_data = np.empty(int(pos_boffs[-1]), dtype=np.uint8)
+      pos_vals_p = pos_vals.ctypes.data_as(c.POINTER(c.c_uint16))
+      pos_data_p = pos_data.ctypes.data_as(c.c_char_p)
+    else:
+      pos_data = None
+      pos_vals_p = pos_data_p = None
+    rc = self._lib.lddl_columnar_emit(
+        self._model, ncols, ids_p, offs_p, ns.ctypes.data_as(_i64p),
+        out_offs_p, out_data_p, caps.ctypes.data_as(_i64p), pos_vals_p,
+        pos_offs_p, pos_n, pos_boffs_p, pos_data_p, self._nthreads)
+    if rc == -2:
+      raise ValueError(
+          'joined string column exceeds 2 GiB (Arrow int32 offset limit); '
+          'split the partition into smaller batches')
+    if rc < 0:
+      raise RuntimeError('native columnar emit overflow (capacity bug)')
+    string_parts = [(oo, od[:int(oo[-1])]) for oo, od in out]
+    pos_parts = (pos_boffs, pos_data) if positions is not None else None
+    return string_parts, pos_parts
+
   def decode_join(self, ids, offsets):
     """ids ranges -> list of space-joined token strings."""
     out_offsets, data = self.decode_join_buffers(ids, offsets)

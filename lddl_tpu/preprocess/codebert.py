@@ -31,7 +31,7 @@ import pyarrow as pa
 from ..core import attach_bool_arg
 from ..core.random import rng_from_key
 from ..pipeline.executor import Executor
-from ..pipeline.parquet_io import write_samples_partition
+from ..pipeline.parquet_io import write_samples_partition, write_table_partition
 from ..pipeline.pool import current_writer
 from ..pipeline.shuffle import gather_partition
 from .common import run_shuffled
@@ -57,8 +57,9 @@ def truncate_seq(tokens, max_num_tokens, rng):
       tokens.pop()
 
 
-def documents_from_records(records, tokenizer, max_length=512):
-  """Parse + batch-tokenize bimodal records into CodeDocuments."""
+def _parse_records(records):
+  """Shared record parsing: (doc_id, n_doc, n_code) triples plus the flat
+  list of line strings in tokenization order."""
   parsed = []
   all_strs = []
   for rec in records:
@@ -73,6 +74,12 @@ def documents_from_records(records, tokenizer, max_length=512):
     parsed.append((doc_id, len(doc_lines), len(code_lines)))
     all_strs.extend(doc_lines)
     all_strs.extend(code_lines)
+  return parsed, all_strs
+
+
+def documents_from_records(records, tokenizer, max_length=512):
+  """Parse + batch-tokenize bimodal records into CodeDocuments."""
+  parsed, all_strs = _parse_records(records)
   all_tokens = tokenizer.batch_tokenize(all_strs, max_length=max_length)
   documents, pos = [], 0
   for doc_id, n_doc, n_code in parsed:
@@ -85,6 +92,32 @@ def documents_from_records(records, tokenizer, max_length=512):
     if code_toks:
       documents.append(CodeDocument(doc_id, doc_toks, code_toks))
   return documents
+
+
+def documents_from_records_ids(records, tokenizer, max_length=512):
+  """Id-range variant of :func:`documents_from_records` for the fused
+  columnar path: the same token stream, but segments stay ``(start, end)``
+  ranges into one flat int32 id array — no Python token strings. Returns
+  ``(documents, flat_ids)``. A document's kept segments are contiguous in
+  ``flat_ids`` (dropped empty lines have zero width), which is what lets
+  the pairing below concatenate segments by merging ranges."""
+  parsed, all_strs = _parse_records(records)
+  flat, offsets = tokenizer.encode_batch_ids(all_strs, max_tokens=max_length)
+  documents, pos = [], 0
+  for doc_id, n_doc, n_code in parsed:
+    doc_segs = tuple(
+        (int(offsets[k]), int(offsets[k + 1]))
+        for k in range(pos, pos + n_doc)
+        if offsets[k + 1] > offsets[k])
+    pos += n_doc
+    code_segs = tuple(
+        (int(offsets[k]), int(offsets[k + 1]))
+        for k in range(pos, pos + n_code)
+        if offsets[k + 1] > offsets[k])
+    pos += n_code
+    if code_segs:
+      documents.append(CodeDocument(doc_id, doc_segs, code_segs))
+  return documents, flat
 
 
 def build_doc_segment(document, max_doc_seq_length, short_seq_prob, rng):
@@ -147,6 +180,72 @@ def create_pairs_from_document(document, rng, max_seq_length=512,
   return instances
 
 
+def truncate_range(start, end, max_num_tokens, rng):
+  """Range form of :func:`truncate_seq`: the draw sequence depends only on
+  the current length, so trimming endpoints consumes exactly the same rng
+  stream as popping list elements."""
+  while end - start > max_num_tokens:
+    if rng.random() < 0.5:
+      start += 1
+    else:
+      end -= 1
+  return start, end
+
+
+def build_doc_range(document, max_doc_seq_length, short_seq_prob, rng):
+  """Range form of :func:`build_doc_segment` over contiguous id segments."""
+  segs = document.doc_segments
+  if not segs:
+    return 0, 0
+  if rng.random() < short_seq_prob:
+    start, end = segs[0]
+  else:
+    chunk_n, length = 0, 0
+    for i, (s, e) in enumerate(segs):
+      chunk_n += 1
+      length += e - s
+      if i == len(segs) - 1 or length > max_doc_seq_length:
+        last = chunk_n - 1 if (length > max_doc_seq_length and
+                               chunk_n > 1) else chunk_n
+        start, end = segs[0][0], segs[last - 1][1]
+        break
+  return truncate_range(start, end, max_doc_seq_length, rng)
+
+
+def create_pair_ranges(document, rng, max_seq_length=512,
+                       short_seq_prob=0.1):
+  """Range form of :func:`create_pairs_from_document`: identical draws and
+  carry-over semantics, but yields ``((doc_start, doc_end),
+  (code_start, code_end), num_tokens)`` triples into the flat id array
+  instead of materialized string dicts."""
+  special = 3 if document.doc_segments else 2
+  max_num_tokens = max_seq_length - special
+  max_doc_seq_length = 64 if max_seq_length >= 512 else 32
+  ds, de = build_doc_range(document, max_doc_seq_length, short_seq_prob, rng)
+  doc_len = de - ds
+  target = max_num_tokens
+
+  pairs = []
+  segs = document.code_segments
+  first, count, length = 0, 0, doc_len
+  for i, (s, e) in enumerate(segs):
+    if count == 0:
+      first = i
+    count += 1
+    length += e - s
+    if i == len(segs) - 1 or length > target:
+      carry = (length > max_num_tokens and count > 1)
+      cs, ce = truncate_range(segs[first][0], segs[i][1],
+                              max_num_tokens - doc_len, rng)
+      if ce > cs and (not pairs or ce - cs >= MIN_CODE_TOKENS):
+        pairs.append(((ds, de), (cs, ce), doc_len + (ce - cs) + special))
+      if carry:
+        first, count, length = i, 1, doc_len + (e - s)
+      else:
+        count, length = 0, doc_len
+  return pairs
+
+
 CODEBERT_SCHEMA = pa.schema([
     ('id', pa.string()),
     ('doc', pa.string()),
@@ -193,15 +292,96 @@ def _warmup_worker(cfg):
   tokenizer.batch_tokenize(['warmup'])
 
 
+def _columnar_available(tokenizer):
+  """True when the fused native columnar path can run: exercises the real
+  ``LDDL_NATIVE_COLUMNAR`` gate + native-library probe on an empty column,
+  so the path decision happens before any rng draw."""
+  import numpy as np
+
+  from .common import fused_string_columns
+  return fused_string_columns(
+      tokenizer, [(np.zeros(0, np.int32), np.zeros(1, np.int64))]) is not None
+
+
+def _build_partition_table(records, tokenizer, rng, cfg):
+  """Fused fast path: pair ranges over one flat id array -> a single native
+  columnar emit for the doc/code columns -> Arrow table. No id->string
+  decode in Python and no per-instance dicts; shards are byte-identical to
+  the dict path (same tokenization caps, same rng draw sequence, same
+  schema and column order)."""
+  import numpy as np
+
+  from ..ops.masking import ragged_indices
+  from .common import fused_string_columns
+
+  documents, flat = documents_from_records_ids(
+      records, tokenizer, max_length=cfg.target_seq_length)
+  ids_col, triples = [], []
+  for _ in range(cfg.duplicate_factor):
+    for document in documents:
+      for tr in create_pair_ranges(document, rng,
+                                   max_seq_length=cfg.target_seq_length,
+                                   short_seq_prob=cfg.short_seq_prob):
+        ids_col.append(document.doc_id)
+        triples.append(tr)
+  if not triples:
+    return CODEBERT_SCHEMA.empty_table()
+
+  def _flatten(ranges):
+    ranges = np.asarray(ranges, dtype=np.int64)
+    lens = ranges[:, 1] - ranges[:, 0]
+    offs = np.zeros(len(ranges) + 1, dtype=np.int64)
+    np.cumsum(lens, out=offs[1:])
+    row, col = ragged_indices(lens)
+    return flat[ranges[row, 0] + col], offs
+
+  doc_flat, doc_offs = _flatten([t[0] for t in triples])
+  code_flat, code_offs = _flatten([t[1] for t in triples])
+  emitted = fused_string_columns(
+      tokenizer, [(doc_flat, doc_offs), (code_flat, code_offs)])
+  if emitted is not None:
+    (string_parts, _) = emitted
+
+    def _col(part):
+      oo, dd = part
+      return pa.StringArray.from_buffers(
+          len(oo) - 1, pa.py_buffer(oo), pa.py_buffer(dd))
+
+    doc_col, code_col = _col(string_parts[0]), _col(string_parts[1])
+  else:  # native vanished between probe and emit; decode in Python
+    doc_col = pa.array(tokenizer.decode_join(doc_flat, doc_offs),
+                       type=pa.string())
+    code_col = pa.array(tokenizer.decode_join(code_flat, code_offs),
+                        type=pa.string())
+  return pa.table({
+      'id': pa.array(ids_col, type=pa.string()),
+      'doc': doc_col,
+      'code': code_col,
+      'num_tokens': pa.array([t[2] for t in triples], type=pa.uint16()),
+  })
+
+
 def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg,
                        delimiter='\r\n'):
   del global_idx
   tokenizer = _get_tokenizer(cfg)
   records = gather_partition(tgt_idx, spill_dir, cfg.seed,
                              delimiter=delimiter)
+  rng = rng_from_key(cfg.seed, 'code-pairs', tgt_idx)
+  if _columnar_available(tokenizer):
+    table = _build_partition_table(records, tokenizer, rng, cfg)
+    out = write_table_partition(
+        table,
+        out_dir,
+        tgt_idx,
+        bin_size=cfg.bin_size,
+        nbins=cfg.nbins,
+        output_format=cfg.output_format,
+        writer=current_writer(),
+    )
+    return {b: n for b, (_, n) in out.items()}
   documents = documents_from_records(records, tokenizer,
                                      max_length=cfg.target_seq_length)
-  rng = rng_from_key(cfg.seed, 'code-pairs', tgt_idx)
   instances = []
   for _ in range(cfg.duplicate_factor):
     for document in documents:

@@ -11,6 +11,32 @@ from ..pipeline.shuffle import shuffle_corpus
 _TOKENIZER_CACHE = {}
 
 
+def native_columnar_enabled():
+  """The ``LDDL_NATIVE_COLUMNAR`` gate for the fused native
+  encode->columnar shard assembly (default on; the native library being
+  unavailable still falls back per call, so 'on' is always safe).
+  Outputs are byte-identical either way — the gate exists for A/B
+  benchmarking and as an escape hatch."""
+  return os.environ.get('LDDL_NATIVE_COLUMNAR', '').strip().lower() not in (
+      '0', 'false', 'off', 'no')
+
+
+def fused_string_columns(tokenizer, columns, positions=None):
+  """Gate + fallback probe for the fused columnar build.
+
+  Returns ``(string_parts, pos_parts)`` from the tokenizer's native
+  :meth:`columnar_emit`, or ``None`` when the gate is off or the native
+  library is unavailable (callers use the per-column
+  ``decode_join_buffers`` + numpy-framing path instead).
+  """
+  if not native_columnar_enabled():
+    return None
+  emit = getattr(tokenizer, 'columnar_emit', None)
+  if emit is None:
+    return None
+  return emit(columns, positions=positions)
+
+
 def get_cached_tokenizer(vocab_file=None, hub_name=None, lowercase=True,
                          backend='hf'):
   """One tokenizer per (vocab, name, case, backend) per worker process."""

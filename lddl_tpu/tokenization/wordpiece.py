@@ -143,6 +143,16 @@ class BertWordPiece:
       return None
     return joiner.decode_join_buffers(ids, offsets)
 
+  def columnar_emit(self, columns, positions=None):
+    """Fused native Arrow-column build (see
+    :meth:`lddl_tpu.native.wordpiece.NativeWordPiece.columnar_emit`), or
+    ``None`` when the native library is unavailable — callers fall back
+    to :meth:`decode_join_buffers` / numpy framing."""
+    joiner = self._get_joiner()
+    if joiner is None:
+      return None
+    return joiner.columnar_emit(columns, positions=positions)
+
   def _get_joiner(self):
     """A native decoder even on the hf backend (built from vocab_words);
     None when the native library cannot be built."""

@@ -168,6 +168,34 @@ def test_loader_bench_smoke(tmp_path, capsys):
   assert json.loads(lines[-1])['metric'] == 'loader_bench_summary'
 
 
+def test_h2d_bench_smoke(capsys):
+  """h2d_bench feeds a synthetic loader through prefetch_to_device and
+  derives the overlap fraction from the same train.h2d/train.compute
+  trace spans a real run exports."""
+  bench = _load('h2d_bench')
+  result = bench.main(
+      ['--iters', '6', '--batch-size', '8', '--seq-length', '64'])
+  assert result['metric'] == 'h2d_overlap_fraction'
+  assert 0.0 <= result['value'] <= 1.0
+  assert result['h2d_spans'] == 6
+  assert result['batches_per_sec'] > 0
+  assert result['donation_contract_held'] is True
+  line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+  assert line['metric'] == 'h2d_overlap_fraction'
+
+
+def test_h2d_overlap_fraction_math():
+  bench = _load('h2d_bench')
+  f = bench.overlap_fraction
+  # fully covered, half covered, disjoint
+  assert f([(0.0, 1.0)], [(0.0, 2.0)]) == pytest.approx(1.0)
+  assert f([(0.0, 1.0)], [(0.5, 2.0)]) == pytest.approx(0.5)
+  assert f([(0.0, 1.0)], [(2.0, 1.0)]) == 0.0
+  # overlapping compute spans must not double-count coverage
+  assert f([(0.0, 1.0)], [(0.0, 0.8), (0.2, 0.8)]) == pytest.approx(1.0)
+  assert f([], [(0.0, 1.0)]) == 0.0
+
+
 def test_loader_bench_committed_artifact_meets_speedup_floor():
   """The committed sweep artifact must demonstrate the shm transport's
   reason to exist: >= 1.5x batches/s over the pickling queue for

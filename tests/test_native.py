@@ -166,6 +166,51 @@ class TestDecode:
       pickle.dumps(nat)
 
 
+class TestColumnarEmit:
+  """The fused encode->columnar entry point must reproduce the separate
+  decode_join_buffers + numpy-framing path byte for byte."""
+
+  def test_string_columns_match_decode_join_buffers(self, hf_and_native):
+    _, nat = hf_and_native
+    cols = []
+    for texts in (['the dog ran.', '', 'cat ran fast'],
+                  ['kindness readable café', '中国 3.14']):
+      cols.append(nat.encode_batch_ids(texts))
+    # An out-of-range id must size and decode as [UNK] on both paths.
+    bad_ids = np.array([0, 99999, 1], np.int32)
+    bad_offs = np.array([0, 3], np.int64)
+    cols.append((bad_ids, bad_offs))
+    string_parts, pos_parts = nat.columnar_emit(cols)
+    assert pos_parts is None
+    assert len(string_parts) == len(cols)
+    for (ids, offs), (oo, data) in zip(cols, string_parts):
+      ref_oo, ref_data = nat.decode_join_buffers(ids, offs)
+      np.testing.assert_array_equal(oo, ref_oo)
+      assert data.tobytes() == ref_data.tobytes()
+
+  def test_positions_match_numpy_framing(self, hf_and_native):
+    from lddl_tpu.core.utils import u16_batch_binary_parts
+    _, nat = hf_and_native
+    ids, offs = nat.encode_batch_ids(['the dog', 'cat ran'])
+    vals = np.array([3, 0, 65535, 7, 9], np.uint16)
+    # Includes a zero-length row and non-zero-based sub-span offsets.
+    poffs = np.array([1, 3, 3, 5], np.int64) + 0
+    string_parts, pos_parts = nat.columnar_emit([(ids, offs)],
+                                                positions=(vals, poffs))
+    boffs, bdata = pos_parts
+    ref_boffs, ref_bdata = u16_batch_binary_parts(vals, poffs)
+    np.testing.assert_array_equal(boffs, np.asarray(ref_boffs))
+    assert bdata.tobytes() == np.asarray(ref_bdata).tobytes()
+
+  def test_empty_and_zero_columns(self, hf_and_native):
+    _, nat = hf_and_native
+    empty = (np.zeros(0, np.int32), np.zeros(1, np.int64))
+    string_parts, pos_parts = nat.columnar_emit([empty])
+    assert pos_parts is None
+    oo, data = string_parts[0]
+    assert list(oo) == [0] and len(data) == 0
+
+
 def test_pairing_falls_back_without_toolchain(monkeypatch):
   """A host without g++ must degrade to the Python planner with a warning,
   not crash at first use (the build runs lazily inside the probe)."""
