@@ -233,6 +233,10 @@ def main():
         'transport': _resolve_transport(None),
         'zero_copy': _resolve_zero_copy(None),
         'native_columnar': native_columnar_enabled(),
+        # Whether the LDDL_MONITOR live endpoint was serving during the
+        # measurement (its thread shares the host CPU with the pipeline).
+        'monitor': os.environ.get('LDDL_MONITOR', '') not in
+                   ('', '0', 'false', 'off', 'no'),
     }
     result.update(_telemetry_artifacts())
     result.update(_lint_status())

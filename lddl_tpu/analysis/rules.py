@@ -178,7 +178,12 @@ class WallClockControlFlow(Rule):
           'metrics belongs in telemetry/')
 
   def exempt(self, ctx):
-    # Telemetry is *about* time; its comparisons never steer the pipeline.
+    # Telemetry is *about* time; its comparisons never steer the
+    # pipeline. This covers the whole package, explicitly including the
+    # live-observability modules (telemetry/live.py windowed rates,
+    # telemetry/server.py LDDL_MONITOR endpoint, telemetry/monitor.py
+    # dashboard repaint loop): their time arithmetic produces
+    # rates/verdicts for operators, never a branch a rank acts on.
     return ctx.path_is('telemetry/')
 
   def begin_module(self, ctx):

@@ -20,6 +20,10 @@ fork's CodeBERT wrapper), all thin delegates:
   lddl_analyze                   -> lddl_tpu.analysis.cli (SPMD
                                     determinism & resource-safety
                                     linter; the tier-1 self-check gate)
+  lddl_monitor                   -> lddl_tpu.telemetry.monitor (live
+                                    dashboard over LDDL_MONITOR
+                                    endpoints: rates, verdict,
+                                    stragglers, goodput)
 
 Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
 installed console scripts.
@@ -103,6 +107,11 @@ def lddl_analyze(args=None):
   return main(args)
 
 
+def lddl_monitor(args=None):
+  from .telemetry.monitor import main
+  return main(args)
+
+
 _COMMANDS = {
     'download_wikipedia': download_wikipedia,
     'download_books': download_books,
@@ -123,6 +132,8 @@ _COMMANDS = {
     'telemetry-trace': telemetry_trace,  # dash-form alias
     'lddl_analyze': lddl_analyze,
     'lddl-analyze': lddl_analyze,  # dash-form alias
+    'lddl_monitor': lddl_monitor,
+    'lddl-monitor': lddl_monitor,  # dash-form alias
 }
 
 

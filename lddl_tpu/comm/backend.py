@@ -44,6 +44,14 @@ class CommBackend:
     """Gather one picklable object per rank; returns list ordered by rank."""
     raise NotImplementedError
 
+  @property
+  def collective_seq(self):
+    """Monotonic count of collectives issued so far, or None if the
+    backend does not sequence them. The same counter trace alignment
+    keys on — consumers tagging gathered payloads with it can reject
+    entries from mismatched rounds."""
+    return None
+
   def allreduce_sum(self, array):
     """Element-wise sum of a small numpy array across ranks."""
     arrays = self.allgather_object(np.asarray(array))
@@ -125,6 +133,10 @@ class FileBackend(CommBackend):
   @property
   def world_size(self):
     return self._world_size
+
+  @property
+  def collective_seq(self):
+    return self._seq
 
   def _path(self, seq, rank):
     return os.path.join(self._dir, f'{self._run_id}.op{seq}.rank{rank}')
@@ -362,6 +374,10 @@ class JaxProcessBackend(CommBackend):
   @property
   def world_size(self):
     return self._jax.process_count()
+
+  @property
+  def collective_seq(self):
+    return self._seq
 
   def allgather_object(self, obj):
     from jax.experimental import multihost_utils

@@ -11,6 +11,29 @@ here it exists once).
 import logging
 import os
 import pathlib
+import warnings
+
+_WARNED = set()  # messages already emitted by warn_once (process-local)
+
+
+def warn_once(message, logger=None, category=UserWarning, stacklevel=2):
+  """Emit ``message`` at most once per process.
+
+  Loader hot paths hit the same degenerate condition (no pad token,
+  oversized document, ...) once per batch or per row; repeating the
+  warning thousands of times buries real signal. Routed to ``logger``
+  when one is provided (scope-aware, so multi-rank runs don't multiply
+  it further), else to :mod:`warnings`. Returns True when the message
+  was actually emitted.
+  """
+  if message in _WARNED:
+    return False
+  _WARNED.add(message)
+  if logger is not None:
+    logger.warning(message)
+  else:
+    warnings.warn(message, category, stacklevel=stacklevel + 1)
+  return True
 
 
 class DummyLogger:

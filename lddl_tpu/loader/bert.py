@@ -31,6 +31,7 @@ import time
 import numpy as np
 
 from ..comm import get_backend
+from ..core.log import warn_once
 from ..core.utils import (get_all_bin_ids, get_all_parquets_under,
                           get_file_paths_for_bin_id)
 from ..telemetry import get_telemetry
@@ -87,8 +88,7 @@ class BertCollate:
     self._sep_id = tokenizer.sep_token_id
     self._mask_id = tokenizer.mask_token_id
     if tokenizer.pad_token_id is None:
-      import warnings
-      warnings.warn(
+      warn_once(
           'tokenizer defines no pad token; padding input_ids with id 0 — '
           'for BPE vocabs id 0 is a real token (<s>), harmless for loss '
           '(attention_mask covers pads) but visible to consumers '
@@ -205,6 +205,11 @@ class BertCollate:
           time.monotonic() - t0)
       tele.counter('loader.batches').add(1)
       tele.counter('loader.collated_rows').add(n)
+      # Goodput accounting per bin: real (attended) tokens vs the padded
+      # token slots the batch physically ships — the live padding-
+      # efficiency meter binning claims to maximize.
+      tele.counter(f'loader.tokens_real.s{seq_len}').add(int(total.sum()))
+      tele.counter(f'loader.tokens_padded.s{seq_len}').add(n * seq_len)
     if tracer.enabled:
       tracer.complete(f'loader.collate.s{seq_len}', t0,
                       time.monotonic() - t0, args={'step': step, 'rows': n})
@@ -357,8 +362,11 @@ def build_pretrain_loader(
 
   from ..core.log import DatasetLogger
   from ..core.topology import discover_topology
+  from ..telemetry.server import maybe_start_monitor
   comm = comm or get_backend()
   topo = discover_topology(comm)
+  # Live metrics endpoint (LDDL_MONITOR): no-op singleton when unset.
+  maybe_start_monitor(rank=dp_rank)
   # Default level mirrors the reference factory (WARNING): library code
   # must not chat on stderr unless asked; the drop-last/truncation loss
   # warnings still get through.

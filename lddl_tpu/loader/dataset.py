@@ -29,11 +29,11 @@ sample order byte-identical.
 """
 
 import os
-import warnings
 
 import pyarrow.parquet as pq
 
 from ..balance import load_num_samples_cache
+from ..core.log import warn_once
 from ..core.random import rng_from_key
 from ..core.utils import count_parquet_samples_strided
 from ..telemetry import get_telemetry
@@ -106,7 +106,9 @@ class ParquetShardDataset:
     if lost > 0:
       msg = (f'truncating shards to {lo} samples each: {lost} samples lost '
              f'out of {sum(values)}')
-      (self._log.warning(msg) if self._log else warnings.warn(msg))
+      # Once per process: re-instantiated datasets (per bin, per epoch
+      # resume) would otherwise repeat the identical truncation warning.
+      warn_once(msg, logger=self._log)
 
   @property
   def num_files(self):

@@ -25,6 +25,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from ..telemetry import get_telemetry
 from ..telemetry.trace import get_tracer
 
 _DEFAULT_MESH = None
@@ -130,13 +131,17 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
     return False
 
   tracer = get_tracer()
+  # Histogram twin of the train.h2d trace span: the live overlap meter
+  # needs h2d totals in the metrics registry (1 - data_wait/h2d), and
+  # spans only land in the trace ring. Handle fetched once per prefetch.
+  h2d_hist = get_telemetry().histogram('train.h2d_seconds')
 
   def _producer():
     try:
       for item in iterator:
         # The host-to-device transfer phase, on the producer thread's
         # own trace lane (overlaps the main thread's compute span).
-        with tracer.span('train.h2d'):
+        with tracer.span('train.h2d'), h2d_hist.time():
           placed = _put(item)
         if not _blocking_put(placed):
           return

@@ -135,6 +135,7 @@ def _worker_main(build_kwargs, factory, epoch, clear_consumed, w,
     if ring_desc is not None:
       ring = BatchRing.attach(*ring_desc)
     wait_h = tele.histogram('loader.shm_wait_seconds')
+    occupancy_g = tele.gauge('loader.shm_slot_occupancy')
     loader = _resolve_factory(factory)(**build_kwargs)
     loader.epoch = epoch
     if clear_consumed:
@@ -146,14 +147,19 @@ def _worker_main(build_kwargs, factory, epoch, clear_consumed, w,
       t0 = time.monotonic()
       slot = free_q.get()
       wait_h.observe(time.monotonic() - t0)
-      if tracer.enabled:
+      if tele.enabled or tracer.enabled:
         try:  # advisory, like the parent's depth gauge
           free = free_q.qsize()
         except NotImplementedError:
           free = None
         if free is not None:
-          tracer.counter(f'loader.shm_slot_occupancy.w{w}',
-                         ring.num_slots - free)
+          # Occupied slots = parent-side backpressure: a full ring means
+          # the consumer is behind. The gauge feeds the live goodput
+          # meters; the trace counter keeps its per-worker lane.
+          occupancy_g.set(ring.num_slots - free)
+          if tracer.enabled:
+            tracer.counter(f'loader.shm_slot_occupancy.w{w}',
+                           ring.num_slots - free)
       try:
         spec = ring.pack(slot, batch)
       except SlotOverflow:

@@ -30,6 +30,7 @@ import weakref
 
 from ..comm import NullBackend
 from ..telemetry import get_telemetry
+from ..telemetry.server import maybe_start_monitor
 from ..telemetry.trace import get_tracer
 from .pool import (AsyncShardWriter, PoolBroken, WorkerPool,
                    _default_mp_context, install_writer, write_back_enabled)
@@ -104,6 +105,9 @@ class ProgressReporter:
         'tasks_per_sec': round(rate, 3) if rate else None,
         'eta_sec': round(eta, 1) if eta is not None else None,
         'updated_unix': time.time(),
+        # Monotonic phase clock so live rate windows over successive
+        # heartbeats never depend on wall time (eta_sec is unchanged).
+        'monotonic_elapsed_sec': round(now - self._t0, 3),
     }
     if extra:
       record.update(extra)
@@ -146,6 +150,8 @@ class Executor:
     # '0'/'false'/'off' must disable, not become a directory named '0'.
     self._progress = (ProgressReporter(spec, self._comm.rank)
                       if spec not in ('', '0', 'false', 'off') else None)
+    # Live metrics endpoint (LDDL_MONITOR): no-op singleton when unset.
+    maybe_start_monitor(rank=self._comm.rank)
 
   @property
   def comm(self):
@@ -297,6 +303,7 @@ class Executor:
     # the GIL), so encode of shard N+1 overlaps the write of shard N.
     writer = AsyncShardWriter() if write_back_enabled() else None
     previous = install_writer(writer)
+    progress_gauge = tele.gauge(f'pipeline.{label}.progress_frac')
     try:
       for i in my_indices:
         gi, res, t0, dt, pid = _run_task(fn, i, tasks[i])
@@ -304,6 +311,7 @@ class Executor:
         tasks_done.add(1)
         tracer.complete(task_name, t0, dt, tid=pid)
         local_results.append((gi, res))
+        progress_gauge.set(len(local_results) / total)
         if self._progress:
           self._progress.update(label, len(local_results), total)
       if writer is not None:
@@ -332,6 +340,7 @@ class Executor:
     steals = tele.counter(f'pipeline.{label}.steals')
     idle_hist = tele.histogram(f'pipeline.{label}.worker_idle_seconds')
     depth_gauge = tele.gauge('pipeline.pool.queue_depth')
+    progress_gauge = tele.gauge(f'pipeline.{label}.progress_frac')
     done = 0
 
     def on_result(msg):
@@ -340,6 +349,7 @@ class Executor:
       done += 1
       pending = total - done
       depth_gauge.set(pending)
+      progress_gauge.set(done / total)
       if terr is None:
         task_hist.observe(dt)
         tasks_done.add(1)

@@ -36,6 +36,8 @@ import threading
 import time
 import traceback
 
+from ..telemetry import get_telemetry
+
 
 def _default_mp_context():
   """fork is fastest, but forking a process that has initialized JAX (its
@@ -86,6 +88,10 @@ class AsyncShardWriter:
     self._thread.start()
 
   def _run(self):
+    # Completed write-backs are the straggler signal for the write side
+    # (windowed writes/sec vs the fleet median in telemetry.live); the
+    # handle is fetched once per writer thread, off the submit path.
+    writes = get_telemetry().counter('pipeline.pool.writes')
     while True:
       job = self._q.get()
       if job is None:
@@ -94,6 +100,7 @@ class AsyncShardWriter:
       fn, args, kwargs = job
       try:
         fn(*args, **kwargs)
+        writes.add(1)
       except BaseException:
         if self._err is None:  # first failure wins; later shards still run
           self._err = traceback.format_exc()

@@ -19,16 +19,45 @@ A sibling event-level layer (:mod:`.trace`, env ``LDDL_TRACE``) records
 (``trace.rank<R>[.pid<P>].jsonl``); ``python -m lddl_tpu.cli
 telemetry-trace`` merges all ranks into one clock-aligned
 Chrome-trace-format JSON for Perfetto / ``chrome://tracing``.
+
+The live plane (:mod:`.live` + :mod:`.server`, env ``LDDL_MONITOR``)
+serves the same registry *during* the run: windowed snapshot deltas
+feeding the report's bottleneck verdict online, per-rank straggler
+scores over the comm backend, goodput/padding-efficiency meters, and a
+per-process HTTP endpoint (JSON ``/snapshot`` + Prometheus
+``/metrics``) that ``python -m lddl_tpu.cli lddl-monitor`` turns into a
+refreshing terminal dashboard. Same no-op discipline: unset means zero
+threads, zero sockets.
 """
 
 from .metrics import (
     NOOP,
     NoopTelemetry,
     Telemetry,
+    diff_snapshot_lines,
     disable,
     enable,
     get_telemetry,
     rank_file_name,
+)
+from .live import (
+    SnapshotWindow,
+    goodput_meters,
+    live_status,
+    live_verdict,
+    rank_signals,
+    stage_rates,
+    straggler_over_comm,
+    straggler_scores,
+)
+from .server import (
+    NOOP_MONITOR,
+    MonitorServer,
+    NoopMonitor,
+    get_monitor,
+    maybe_start_monitor,
+    prometheus_lines,
+    stop_monitor,
 )
 from .report import (
     aggregate_over_comm,

@@ -1,0 +1,372 @@
+"""Streaming verdict engine: windowed rates, straggler scores, goodput.
+
+The PR 1-2 telemetry stack is post-hoc — metrics land in per-rank JSONL
+and verdicts are computed after the run. The ROADMAP directions that
+consume telemetry (cross-rank work stealing, loader-as-a-data-service)
+need the same signals *while the run is going*: who is slow right now,
+is the pipeline loader- or compute-bound right now, how much of the
+padded-token budget is real work. This module derives all of that from
+the existing :class:`~lddl_tpu.telemetry.metrics.Telemetry` registry —
+no new lock, no sampler thread of its own; whoever polls (the
+``LDDL_MONITOR`` HTTP server, a test, rank 0's aggregation round)
+drives the sampling cadence.
+
+Three layers, each a pure function of registry snapshots:
+
+  - :class:`SnapshotWindow` — a bounded deque of ``snapshot_lines()``
+    captures; ``delta()`` subtracts oldest from newest
+    (:func:`~.metrics.diff_snapshot_lines`), so every rate is over the
+    window's *monotonic* span, never wall clock;
+  - :func:`live_verdict` / :func:`stage_rates` — the windowed delta
+    merged through the offline report machinery
+    (:func:`~.report.merge_metric_lines` + ``summarize_stages``), i.e.
+    the exact bottleneck logic the post-hoc report applies, online;
+  - :func:`rank_signals` / :func:`straggler_scores` — per-rank
+    task-completion / write-back / row / step rates vs the fleet
+    median, aggregated over the run's own comm backend
+    (:func:`straggler_over_comm`) with the same seq-keyed discipline
+    trace alignment uses, and :func:`goodput_meters` — padding
+    efficiency, step-cache hit rate, h2d/compute overlap, queue/slot
+    backpressure.
+"""
+
+import collections
+import math
+import os
+import time
+
+from .metrics import diff_snapshot_lines, get_telemetry
+from .report import merge_metric_lines, summarize_stages
+
+
+class SnapshotWindow:
+  """Rolling registry captures; rates/percentiles over the last N.
+
+  ``sample()`` appends the live registry's ``snapshot_lines()`` (each
+  capture carries its own ``(unix, monotonic)`` anchor pair);
+  ``push()`` accepts pre-built lines for synthetic/offline use.
+  ``delta()`` diffs the oldest retained capture against the newest, so
+  the window span grows until the deque is full and then slides.
+  """
+
+  def __init__(self, capacity=12):
+    if capacity < 2:
+      raise ValueError(f'window capacity must be >= 2, got {capacity}')
+    self._snaps = collections.deque(maxlen=capacity)
+
+  def __len__(self):
+    return len(self._snaps)
+
+  def sample(self, telemetry=None, rank=0):
+    """Capture the live registry (or ``telemetry``) into the window."""
+    tele = telemetry if telemetry is not None else get_telemetry()
+    lines = tele.snapshot_lines(rank=rank)
+    if lines:
+      self._snaps.append(lines)
+    return lines
+
+  def push(self, lines):
+    """Append pre-built snapshot lines (tests, replayed JSONL)."""
+    self._snaps.append(lines)
+
+  def delta(self):
+    """Windowed delta lines (oldest -> newest), or None if < 2 samples."""
+    if len(self._snaps) < 2:
+      return None
+    return diff_snapshot_lines(self._snaps[0], self._snaps[-1])
+
+  def window_sec(self):
+    """Monotonic span the current delta covers (0.0 if < 2 samples)."""
+    d = self.delta()
+    if d is None:
+      return 0.0
+    for line in d:
+      if line.get('kind') == 'meta':
+        return line.get('window_sec', 0.0)
+    return 0.0
+
+
+def _merged_delta(window):
+  d = window.delta()
+  if d is None:
+    return None, 0.0
+  merged = merge_metric_lines([d])
+  sec = window.window_sec()
+  return merged, sec
+
+
+def stage_rates(window):
+  """Per-counter events/sec over the window: ``{name: rate}``.
+
+  Histogram names get ``<name>.rate`` (occurrences/sec) plus
+  ``<name>.mean`` (mean seconds within the window) so per-stage span
+  costs read online the way the report prints them post-hoc.
+  """
+  merged, sec = _merged_delta(window)
+  if merged is None or sec <= 0:
+    return {}
+  rates = {}
+  for name, m in merged['metrics'].items():
+    if m['kind'] == 'counter':
+      if m['total']:
+        rates[name] = m['total'] / sec
+    elif m['kind'] == 'histogram' and m['count']:
+      rates[name + '.rate'] = m['count'] / sec
+      rates[name + '.mean'] = m['sum'] / m['count']
+  return rates
+
+
+def live_verdict(window):
+  """The post-hoc bottleneck verdict, computed over the live window.
+
+  Returns ``summarize_stages``' dict plus ``window_sec``; falls back to
+  ``{'bottleneck': 'unknown (window warming up)'}`` until the window
+  holds two samples.
+  """
+  merged, sec = _merged_delta(window)
+  if merged is None:
+    return {'stages': {}, 'bottleneck': 'unknown (window warming up)',
+            'detail': '', 'window_sec': 0.0}
+  verdict = summarize_stages(merged)
+  verdict['window_sec'] = sec
+  return verdict
+
+
+# ---------------------------------------------------------------------------
+# goodput / padding-efficiency meters
+
+
+def _counter_total(metrics, name):
+  m = metrics.get(name)
+  return m.get('total', 0) if m and m['kind'] == 'counter' else 0
+
+
+def _hist_sum(metrics, name):
+  m = metrics.get(name)
+  return m.get('sum', 0.0) if m and m['kind'] == 'histogram' else 0.0
+
+
+def _gauge(metrics, name):
+  m = metrics.get(name)
+  if not m or m['kind'] != 'gauge':
+    return None
+  if 'mean' in m:
+    return {'mean': m['mean'], 'min': m['min'], 'max': m['max']}
+  v = m.get('value')
+  return None if v is None else {'mean': v, 'min': v, 'max': v}
+
+
+def goodput_meters(merged):
+  """Efficiency meters from a merged metrics dict (cumulative snapshot
+  or windowed delta — both work; pass the delta for \"right now\").
+
+  Returns a dict of named meters, each ``None`` when its inputs are not
+  instrumented in this process:
+
+    - ``padding_efficiency``: real tokens / padded token slots across
+      the binned collates (per-bin breakdown under ``per_bin``) — the
+      live accounting for the waste binning exists to eliminate;
+    - ``step_cache_hit_rate``: warm-executable fraction of train steps;
+    - ``h2d_overlap_fraction``: 1 - data_wait/h2d — how much of the
+      host-to-device transfer hides behind compute;
+    - ``queue_depth`` / ``shm_slot_occupancy`` / ``writer_backlog``:
+      backpressure gauges (mean/min/max) from the loader transport and
+      the async shard writer.
+  """
+  metrics = merged['metrics']
+  out = {}
+
+  real_total, padded_total, per_bin = 0, 0, {}
+  for name, m in metrics.items():
+    if m['kind'] != 'counter' or not name.startswith('loader.tokens_real.s'):
+      continue
+    seq = name[len('loader.tokens_real.s'):]
+    real = m['total']
+    padded = _counter_total(metrics, f'loader.tokens_padded.s{seq}')
+    real_total += real
+    padded_total += padded
+    if padded:
+      per_bin[f's{seq}'] = real / padded
+  if padded_total:
+    out['padding_efficiency'] = real_total / padded_total
+    out['padding_efficiency_per_bin'] = per_bin
+    out['tokens_real'] = real_total
+    out['tokens_padded'] = padded_total
+  else:
+    out['padding_efficiency'] = None
+
+  hits = _counter_total(metrics, 'train.step_cache_hits')
+  misses = _counter_total(metrics, 'train.step_cache_misses')
+  out['step_cache_hit_rate'] = (
+      hits / (hits + misses) if hits + misses else None)
+
+  h2d = _hist_sum(metrics, 'train.h2d_seconds')
+  wait = _hist_sum(metrics, 'train.data_wait_seconds')
+  if h2d > 0:
+    # The producer thread transfers batch k+1 while the main thread
+    # computes batch k; the part that did NOT hide behind compute is
+    # exactly what the main thread then waits out as data_wait.
+    out['h2d_overlap_fraction'] = max(0.0, min(1.0, 1.0 - wait / h2d))
+  else:
+    out['h2d_overlap_fraction'] = None
+
+  out['queue_depth'] = _gauge(metrics, 'loader.queue_depth')
+  out['shm_slot_occupancy'] = _gauge(metrics, 'loader.shm_slot_occupancy')
+  out['writer_backlog'] = _gauge(metrics, 'pipeline.pool.writer_backlog')
+  return out
+
+
+# ---------------------------------------------------------------------------
+# straggler scores
+
+
+# Counter families whose windowed rate is a per-rank progress signal.
+# Executor task completion and background write-back lead (the work-
+# stealing consumer's signals); loader rows and train steps cover runs
+# without a preprocess phase.
+_SIGNAL_STEPS = 'steps_per_sec'
+
+
+def rank_signals(window):
+  """This rank's progress rates over its window: the straggler inputs.
+
+  ``{'tasks_per_sec', 'writes_per_sec', 'rows_per_sec',
+  'steps_per_sec'}`` — each None when that subsystem produced no events
+  in the window, so the fleet comparison only weighs signals a rank
+  actually runs.
+  """
+  merged, sec = _merged_delta(window)
+  out = {'tasks_per_sec': None, 'writes_per_sec': None,
+         'rows_per_sec': None, _SIGNAL_STEPS: None}
+  if merged is None or sec <= 0:
+    return out
+  metrics = merged['metrics']
+  tasks = sum(m['total'] for name, m in metrics.items()
+              if m['kind'] == 'counter' and name.startswith('pipeline.') and
+              name.endswith('.tasks'))
+  if tasks:
+    out['tasks_per_sec'] = tasks / sec
+  writes = _counter_total(metrics, 'pipeline.pool.writes')
+  if writes:
+    out['writes_per_sec'] = writes / sec
+  rows = _counter_total(metrics, 'loader.rows')
+  if rows:
+    out['rows_per_sec'] = rows / sec
+  steps = _counter_total(metrics, 'train.steps')
+  if steps:
+    out[_SIGNAL_STEPS] = steps / sec
+  return out
+
+
+def straggler_scores(per_rank_signals):
+  """Deterministic per-rank slowness scores vs the fleet median.
+
+  ``per_rank_signals``: ``{rank: rank_signals()-dict}``. For every
+  signal at least two ranks report, each rank scores
+  ``median_rate / own_rate`` (> 1 means slower than the fleet median;
+  a rank reporting zero progress on a signal others advance scores
+  ``inf``). A rank's overall score is its worst signal. Pure arithmetic
+  over the gathered rates — every rank computes the identical table.
+
+  Returns ``{'scores': {rank: score}, 'signals': {rank: {signal:
+  per-signal score}}, 'slowest': rank_or_None}``; ``slowest`` is only
+  named when some rank scores > 1 (ties break to the lowest rank).
+  """
+  signal_names = set()
+  for sig in per_rank_signals.values():
+    signal_names.update(k for k, v in sig.items() if v is not None)
+  per_signal = {}  # signal -> {rank: score}
+  for name in sorted(signal_names):
+    rates = {r: s.get(name) for r, s in per_rank_signals.items()
+             if s.get(name) is not None}
+    # A signal only one rank runs (e.g. only rank 0 trains) carries no
+    # fleet comparison; require a quorum of two.
+    if len(rates) < 2:
+      continue
+    ordered = sorted(rates.values())
+    mid = len(ordered) // 2
+    median = (ordered[mid] if len(ordered) % 2 else
+              (ordered[mid - 1] + ordered[mid]) / 2.0)
+    if median <= 0:
+      continue
+    per_signal[name] = {
+        r: (median / rate if rate > 0 else math.inf)
+        for r, rate in rates.items()
+    }
+  scores = {}
+  for rank in per_rank_signals:
+    mine = [tbl[rank] for tbl in per_signal.values() if rank in tbl]
+    scores[rank] = max(mine) if mine else 1.0
+  slowest = None
+  flagged = [r for r in sorted(scores) if scores[r] > 1.0]
+  if flagged:
+    slowest = max(flagged, key=lambda r: (scores[r], -r))
+  by_rank = {r: {name: tbl[r] for name, tbl in per_signal.items()
+                 if r in tbl} for r in per_rank_signals}
+  return {'scores': scores, 'signals': by_rank, 'slowest': slowest}
+
+
+def straggler_over_comm(comm, window, telemetry=None):
+  """Fleet straggler table over the run's own comm backend.
+
+  Every rank contributes its windowed :func:`rank_signals`; the
+  allgather rides the backend's normal collective stream, and each
+  entry is tagged with the backend's collective sequence number (the
+  same seq-keying trace alignment uses) so a consumer merging tables
+  from different rounds can reject mismatched ones. All ranks compute
+  the identical score table; the result is also exported into the
+  registry as ``straggler.rank<R>.score`` gauges so the future
+  cross-rank stealer (and the JSONL export) can consume it without
+  re-gathering.
+  """
+  signals = rank_signals(window)
+  seq = getattr(comm, 'collective_seq', None)
+  gathered = comm.allgather_object(
+      {'rank': comm.rank, 'seq': seq, 'signals': signals})
+  seqs = {e['seq'] for e in gathered if e.get('seq') is not None}
+  result = straggler_scores({e['rank']: e['signals'] for e in gathered})
+  result['seq'] = max(seqs) if seqs else None
+  if len(seqs) > 1:
+    # Backends bump seq per collective, and this allgather IS one
+    # collective all ranks issue together, so the tags agree by
+    # construction; disagreement means a caller mixed backends/rounds.
+    result['seq_mismatch'] = sorted(seqs)
+  tele = telemetry if telemetry is not None else get_telemetry()
+  if tele.enabled:
+    for rank, score in result['scores'].items():
+      if math.isfinite(score):
+        tele.gauge(f'straggler.rank{rank}.score').set(score)
+  return result
+
+
+# ---------------------------------------------------------------------------
+# the one-call status payload the monitor server serves
+
+
+def live_status(window, rank=0, telemetry=None, include_metrics=True):
+  """Everything the ``/snapshot`` endpoint serves, as one JSON-able dict.
+
+  Samples the registry into ``window`` first (the poller's cadence IS
+  the window cadence), then derives rates/verdict/goodput from the
+  windowed delta and this rank's straggler signals from the same
+  window. ``include_metrics=False`` drops the full cumulative dump for
+  lightweight dashboards.
+  """
+  tele = telemetry if telemetry is not None else get_telemetry()
+  lines = window.sample(telemetry=tele, rank=rank)
+  status = {
+      'rank': rank,
+      'pid': os.getpid(),
+      'unix_time': time.time(),
+      'monotonic': time.monotonic(),
+      'window_sec': window.window_sec(),
+      'window_samples': len(window),
+      'rates': stage_rates(window),
+      'verdict': live_verdict(window),
+      'signals': rank_signals(window),
+  }
+  merged_cum = merge_metric_lines([lines]) if lines else {'metrics': {}}
+  status['goodput'] = goodput_meters(merged_cum)
+  if include_metrics:
+    status['metrics'] = lines
+  return status

@@ -288,6 +288,67 @@ def rank_file_name(directory, rank):
   return os.path.join(directory, f'telemetry.rank{rank}.jsonl')
 
 
+def diff_snapshot_lines(old, new):
+  """Windowed delta between two :meth:`Telemetry.snapshot_lines` captures.
+
+  Returns lines in the same wire format (so they feed straight into
+  :func:`~lddl_tpu.telemetry.report.merge_metric_lines` and the verdict
+  logic), but with cumulative kinds reduced to the window:
+
+    - the meta line carries ``window_sec`` — the *monotonic* distance
+      between the two captures, so rates derived from the delta never
+      depend on wall clock;
+    - counters subtract (``total`` = events inside the window);
+    - histograms subtract count/sum/buckets; min/max are not windowable
+      from cumulative state, so the new capture's values pass through as
+      a conservative envelope;
+    - gauges pass through the new capture (last-value semantics).
+
+  Metrics that first appear in ``new`` diff against zero. Negative
+  deltas (a registry recreated mid-window) clamp to zero rather than
+  reporting time running backwards.
+  """
+  old_by_name, old_meta = {}, None
+  for line in old:
+    if line.get('kind') == 'meta':
+      old_meta = line
+    else:
+      old_by_name[line['name']] = line
+  out = []
+  for line in new:
+    if line.get('kind') == 'meta':
+      meta = dict(line)
+      if old_meta is not None:
+        meta['window_sec'] = max(
+            line.get('monotonic', 0.0) - old_meta.get('monotonic', 0.0), 0.0)
+      else:
+        meta['window_sec'] = 0.0
+      out.append(meta)
+      continue
+    prev = old_by_name.get(line['name'])
+    kind = line['kind']
+    if kind == 'gauge' or prev is None:
+      out.append(dict(line))
+      continue
+    d = dict(line)
+    if kind == 'counter':
+      d['total'] = max(line.get('total', 0) - prev.get('total', 0), 0)
+    elif kind == 'histogram':
+      d['count'] = max(line.get('count', 0) - prev.get('count', 0), 0)
+      d['sum'] = max(line.get('sum', 0.0) - prev.get('sum', 0.0), 0.0)
+      old_b = prev.get('buckets') or {}
+      d['buckets'] = {
+          k: v - old_b.get(k, 0)
+          for k, v in (line.get('buckets') or {}).items()
+          if v - old_b.get(k, 0) > 0
+      }
+      if d['count'] == 0:
+        d.pop('min', None)
+        d.pop('max', None)
+    out.append(d)
+  return out
+
+
 _ENV = 'LDDL_TELEMETRY'
 _active = None  # None: not yet resolved from the environment
 

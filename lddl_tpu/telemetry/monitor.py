@@ -1,0 +1,213 @@
+"""``lddl-monitor``: terminal dashboard over live monitor endpoints.
+
+Attaches to a running job — either explicit ``--url`` endpoints or a
+``--dir`` of ``monitor.rank*.json`` announce files (what each
+``LDDL_MONITOR`` server writes into ``LDDL_MONITOR_DIR`` /
+``LDDL_TELEMETRY_DIR``) — polls every rank's ``/snapshot``, and
+repaints a plain-text dashboard (ANSI clear + home; deliberately no
+curses): per-stage rates, the live bottleneck verdict, the fleet
+straggler table (computed client-side from every rank's windowed
+signals, same arithmetic the in-run aggregation uses), and goodput
+meters. ``--once`` renders a single frame; ``--once --json`` emits the
+full merged payload for scripting/CI.
+
+Unix-socket endpoints (``unix:/path``) are reached through a raw
+``http.client`` connection bound to ``AF_UNIX`` — no extra deps.
+"""
+
+import argparse
+import glob
+import http.client
+import json
+import os
+import socket
+import sys
+import time
+import urllib.request
+
+from .live import straggler_scores
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+
+  def __init__(self, path, timeout):
+    super().__init__('localhost', timeout=timeout)
+    self._path = path
+
+  def connect(self):
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(self.timeout)
+    sock.connect(self._path)
+    self.sock = sock
+
+
+def fetch_snapshot(url, timeout=5.0):
+  """GET ``<url>/snapshot`` -> parsed JSON dict. ``url`` is either an
+  ``http://host:port`` endpoint or ``unix:/path/to.sock``."""
+  if url.startswith('unix:'):
+    conn = _UnixHTTPConnection(url[len('unix:'):], timeout)
+    try:
+      conn.request('GET', '/snapshot')
+      resp = conn.getresponse()
+      if resp.status != 200:
+        raise RuntimeError(f'{url}/snapshot -> HTTP {resp.status}')
+      return json.loads(resp.read().decode('utf-8'))
+    finally:
+      conn.close()
+  with urllib.request.urlopen(url.rstrip('/') + '/snapshot',
+                              timeout=timeout) as resp:
+    return json.loads(resp.read().decode('utf-8'))
+
+
+def discover_endpoints(directory):
+  """Endpoint URLs from announce files under ``directory``, rank order."""
+  paths = sorted(glob.glob(os.path.join(directory, 'monitor.rank*.json')))
+  urls = []
+  for p in paths:
+    try:
+      with open(p) as f:
+        info = json.load(f)
+    except (OSError, ValueError):
+      continue  # being rewritten or already torn down; next poll catches up
+    if info.get('url'):
+      urls.append(info['url'])
+  return urls
+
+
+def poll_fleet(urls, timeout=5.0):
+  """One round: every reachable rank's snapshot + the fleet view.
+
+  Returns ``{'ranks': {rank: snapshot}, 'errors': {url: str},
+  'straggler': straggler_scores(...), 'verdict': <rank verdicts>}``.
+  The straggler table is recomputed here from each rank's windowed
+  signals — identical arithmetic to the in-run
+  :func:`~.live.straggler_over_comm` path, so dashboard and stealer
+  agree.
+  """
+  ranks, errors = {}, {}
+  for url in urls:
+    try:
+      snap = fetch_snapshot(url, timeout=timeout)
+      ranks[snap.get('rank', len(ranks))] = snap
+    except (OSError, RuntimeError, ValueError) as e:
+      errors[url] = str(e)
+  fleet = {
+      'ranks': ranks,
+      'errors': errors,
+      'straggler': straggler_scores(
+          {r: s.get('signals', {}) for r, s in ranks.items()})
+      if len(ranks) > 1 else None,
+      'verdicts': {r: s.get('verdict', {}).get('bottleneck', 'unknown')
+                   for r, s in ranks.items()},
+  }
+  return fleet
+
+
+def _fmt_rate(v):
+  if v is None:
+    return '-'
+  if v >= 100:
+    return f'{v:,.0f}'
+  return f'{v:.2f}'
+
+
+def render_frame(fleet, clear=True):
+  """The plain-text dashboard for one poll round."""
+  out = []
+  if clear:
+    out.append('\x1b[2J\x1b[H')
+  out.append('lddl-monitor · %d rank(s) · %s' %
+             (len(fleet['ranks']), time.strftime('%H:%M:%S')))
+  for url, err in sorted(fleet['errors'].items()):
+    out.append(f'  !! {url}: {err}')
+  for rank in sorted(fleet['ranks']):
+    snap = fleet['ranks'][rank]
+    verdict = snap.get('verdict', {})
+    out.append('')
+    out.append(f'rank {rank} (pid {snap.get("pid")}) · window '
+               f'{snap.get("window_sec", 0.0):.1f}s '
+               f'({snap.get("window_samples", 0)} samples)')
+    out.append(f'  verdict: {verdict.get("bottleneck", "unknown")}')
+    if verdict.get('detail'):
+      out.append(f'    {verdict["detail"]}')
+    rates = snap.get('rates', {})
+    shown = sorted(n for n in rates if not n.endswith('.mean'))[:12]
+    for name in shown:
+      unit = '/s' if not name.endswith('.rate') else ' spans/s'
+      out.append(f'  {name:<44s} {_fmt_rate(rates[name]):>12s}{unit}')
+    good = snap.get('goodput', {})
+    meters = []
+    if good.get('padding_efficiency') is not None:
+      meters.append(f'padding-eff {good["padding_efficiency"]:.1%}')
+    if good.get('step_cache_hit_rate') is not None:
+      meters.append(f'step-cache {good["step_cache_hit_rate"]:.1%}')
+    if good.get('h2d_overlap_fraction') is not None:
+      meters.append(f'h2d-overlap {good["h2d_overlap_fraction"]:.1%}')
+    for g in ('queue_depth', 'shm_slot_occupancy'):
+      if good.get(g):
+        meters.append(f'{g} {good[g]["mean"]:.1f}')
+    if meters:
+      out.append('  goodput: ' + ' · '.join(meters))
+  strag = fleet.get('straggler')
+  if strag:
+    out.append('')
+    out.append('straggler scores (fleet-median / own rate; >1 = slow):')
+    for rank in sorted(strag['scores']):
+      mark = '  <-- slowest' if rank == strag['slowest'] else ''
+      out.append(f'  rank {rank}: {strag["scores"][rank]:.3f}{mark}')
+  return '\n'.join(out)
+
+
+def attach_args(parser):
+  parser.add_argument('--url', action='append', default=[],
+                      help='monitor endpoint (http://host:port or '
+                           'unix:/path); repeatable')
+  parser.add_argument('--dir', default=None,
+                      help='directory of monitor.rank*.json announce files '
+                           '(LDDL_MONITOR_DIR / LDDL_TELEMETRY_DIR)')
+  parser.add_argument('--interval', type=float, default=2.0,
+                      help='seconds between repaints (default 2)')
+  parser.add_argument('--timeout', type=float, default=5.0,
+                      help='per-endpoint HTTP timeout')
+  parser.add_argument('--once', action='store_true',
+                      help='render a single frame and exit')
+  parser.add_argument('--json', action='store_true',
+                      help='with --once: emit the merged fleet payload '
+                           'as JSON instead of the dashboard')
+  return parser
+
+
+def main(args=None):
+  parser = attach_args(argparse.ArgumentParser(
+      description=__doc__,
+      formatter_class=argparse.RawDescriptionHelpFormatter))
+  args = parser.parse_args(args)
+  if not args.url and not args.dir:
+    print('lddl-monitor: provide --url and/or --dir', file=sys.stderr)
+    return 2
+
+  def _endpoints():
+    urls = list(args.url)
+    if args.dir:
+      urls.extend(u for u in discover_endpoints(args.dir) if u not in urls)
+    return urls
+
+  while True:
+    urls = _endpoints()
+    if not urls:
+      print(f'lddl-monitor: no endpoints found '
+            f'(no monitor.rank*.json in {args.dir})', file=sys.stderr)
+      return 2
+    fleet = poll_fleet(urls, timeout=args.timeout)
+    if args.once:
+      if args.json:
+        print(json.dumps(fleet, default=str, indent=2))
+      else:
+        print(render_frame(fleet, clear=False))
+      return 0 if fleet['ranks'] else 1
+    print(render_frame(fleet, clear=True), flush=True)
+    time.sleep(args.interval)
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -24,6 +24,7 @@ import glob
 import json
 import math
 import os
+import sys
 
 
 def load_rank_files(directory):
@@ -288,7 +289,14 @@ def main(args=None):
       description=__doc__,
       formatter_class=argparse.RawDescriptionHelpFormatter))
   args = parser.parse_args(args)
-  merged = merge_metric_lines(load_rank_files(args.dir))
+  try:
+    rank_lines = load_rank_files(args.dir)
+  except FileNotFoundError as e:
+    # An operator pointing at the wrong dir should get one clear line
+    # and a distinct exit code, not a traceback or an empty report.
+    print(f'telemetry-report: {e}', file=sys.stderr)
+    return 2
+  merged = merge_metric_lines(rank_lines)
   if args.json:
     print(json.dumps(merged, default=str, indent=2))
   else:
@@ -297,4 +305,4 @@ def main(args=None):
 
 
 if __name__ == '__main__':
-  main()
+  sys.exit(main())

@@ -41,6 +41,7 @@ import glob
 import json
 import os
 import statistics
+import sys
 import tempfile
 import threading
 import time
@@ -463,7 +464,12 @@ def main(args=None):
       description=__doc__,
       formatter_class=argparse.RawDescriptionHelpFormatter))
   args = parser.parse_args(args)
-  files = load_trace_files(args.dir)
+  try:
+    files = load_trace_files(args.dir)
+  except FileNotFoundError as e:
+    # Same contract as telemetry-report: one clear line, exit code 2.
+    print(f'telemetry-trace: {e}', file=sys.stderr)
+    return 2
   verdict = None
   try:  # metrics snapshots are optional company for the trace files
     from .report import load_rank_files, merge_metric_lines, summarize_stages
@@ -481,4 +487,4 @@ def main(args=None):
 
 
 if __name__ == '__main__':
-  main()
+  sys.exit(main())

@@ -305,8 +305,11 @@ class TrainLoop:
 
     from ..loader.device import prefetch_to_device
     from ..telemetry import get_telemetry
+    from ..telemetry.server import maybe_start_monitor
     from ..telemetry.trace import get_tracer
 
+    # Live metrics endpoint (LDDL_MONITOR): no-op singleton when unset.
+    maybe_start_monitor(rank=max(jax.process_index(), 0))
     global_batch = self.loader.batch_size * max(jax.process_count(), 1)
     tele = get_telemetry()
     tracer = get_tracer()

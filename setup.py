@@ -41,6 +41,7 @@ setup(
             'generate_num_samples_cache='
             'lddl_tpu.cli:generate_num_samples_cache',
             'lddl-analyze=lddl_tpu.analysis.cli:main',
+            'lddl-monitor=lddl_tpu.telemetry.monitor:main',
         ],
     },
 )

@@ -42,10 +42,16 @@ def _reset_telemetry_registries():
   ``LDDL_TELEMETRY``/``LDDL_TRACE`` and re-resolving) without disabling
   must not leak an enabled registry into later tests."""
   import lddl_tpu.telemetry.metrics as _tm
+  import lddl_tpu.telemetry.server as _ts
   import lddl_tpu.telemetry.trace as _tt
   old = (_tm._active, _tt._active)
   yield
   _tm._active, _tt._active = old
+  # A test that started an LDDL_MONITOR server must not leak its thread
+  # (or its cached resolution) into later tests.
+  if _ts._active is not None and _ts._active.enabled:
+    _ts._active.stop()
+  _ts._active = None
 
 
 WORDS = [

@@ -29,3 +29,19 @@ def test_cli_exits_zero_over_package(capsys):
   root = os.path.dirname(os.path.abspath(lddl_tpu.__file__))
   assert cli_main([root]) == 0
   assert 'clean' in capsys.readouterr().out
+
+
+def test_live_observability_modules_lint_clean():
+  """The LDDL_MONITOR plane lints clean on its own — its wall-clock
+  arithmetic is covered by LDA003's telemetry/ exemption (rates and
+  repaint cadence, never control flow a rank acts on), and the server/
+  CLI keep globs sorted and file handles scoped like everything else."""
+  from lddl_tpu.analysis import analyze_paths
+  root = os.path.dirname(os.path.abspath(lddl_tpu.__file__))
+  paths = [os.path.join(root, 'telemetry', m)
+           for m in ('live.py', 'server.py', 'monitor.py', 'metrics.py')]
+  findings, _ = analyze_paths(paths)
+  unsuppressed = [f for f in findings if not f.suppressed]
+  assert not unsuppressed, '\n'.join(f.render() for f in unsuppressed)
+  # no pragmas needed in the monitor plane either
+  assert not [f for f in findings if f.suppressed]

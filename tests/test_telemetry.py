@@ -313,10 +313,20 @@ class TestMergeAndReport:
     merged = json.loads(capsys.readouterr().out)
     assert merged['metrics']['loader.rows']['total'] == 24
 
-  def test_cli_missing_dir_is_loud(self, tmp_path):
+  def test_cli_missing_dir_is_loud(self, tmp_path, capsys):
+    # Operator-facing contract: one clear stderr line + exit code 2, not
+    # a traceback and not an empty report.
     from lddl_tpu import cli
-    with pytest.raises(FileNotFoundError, match='LDDL_TELEMETRY'):
-      cli.telemetry_report(['--dir', str(tmp_path)])
+    assert cli.telemetry_report(['--dir', str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert 'no telemetry.rank*.jsonl files' in err
+    assert str(tmp_path) in err
+
+  def test_trace_cli_missing_dir_is_loud(self, tmp_path, capsys):
+    from lddl_tpu import cli
+    assert cli.telemetry_trace(['--dir', str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert str(tmp_path) in err
 
 
 class TestInstrumentedLayers:

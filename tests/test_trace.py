@@ -253,10 +253,12 @@ class TestPerfettoCli:
     verdict = doc['metadata']['lddl']['bottleneck']
     assert 'loader' in verdict['bottleneck']
 
-  def test_cli_missing_dir_is_loud(self, tmp_path):
+  def test_cli_missing_dir_is_loud(self, tmp_path, capsys):
     from lddl_tpu import cli
-    with pytest.raises(FileNotFoundError, match='LDDL_TRACE'):
-      cli.telemetry_trace(['--dir', str(tmp_path)])
+    assert cli.telemetry_trace(['--dir', str(tmp_path)]) == 2
+    err = capsys.readouterr().err
+    assert 'LDDL_TRACE' in err
+    assert str(tmp_path) in err
 
 
 class TestInstrumentedTraceSites:
