@@ -237,6 +237,14 @@ def main():
         # measurement (its thread shares the host CPU with the pipeline).
         'monitor': os.environ.get('LDDL_MONITOR', '') not in
                    ('', '0', 'false', 'off', 'no'),
+        # Attention masking regime of the training stack this build feeds:
+        # 'full' (whole packed row attends to itself) vs 'block_diagonal'
+        # (per-doc segment ids, cross-doc tiles skipped) — LDDL_BENCH_
+        # BLOCK_DIAGONAL mirrors the trainer's --block-diagonal flag.
+        'attention_mask_mode':
+            'block_diagonal'
+            if os.environ.get('LDDL_BENCH_BLOCK_DIAGONAL', '') not in
+            ('', '0', 'false', 'off', 'no') else 'full',
     }
     result.update(_telemetry_artifacts())
     result.update(_lint_status())

@@ -89,6 +89,95 @@ def _time_per_step(run, n, q, k, v, trials=5):
   return float(np.median(times) * 1000 / n)
 
 
+def ragged_segments(batch, s, k, seed=0):
+  """``[batch, s]`` doc ids: k docs per row with ragged boundaries —
+  jittered around the equal split so none lands on a kernel block edge
+  alignment by construction (the skip logic must not depend on it)."""
+  rng = np.random.default_rng(seed * 1000003 + s * 31 + k)
+  seg = np.zeros((batch, s), np.int32)
+  for b in range(batch):
+    cuts = []
+    for i in range(1, k):
+      base = i * s // k
+      cuts.append(int(np.clip(base + rng.integers(-s // (4 * k),
+                                                  s // (4 * k) + 1),
+                              1, s - 1)))
+    bounds = [0] + sorted(set(cuts)) + [s]
+    for d in range(len(bounds) - 1):
+      seg[b, bounds[d]:bounds[d + 1]] = d
+  return seg
+
+
+def _run_block_diagonal(args):
+  """--block-diagonal: packed-row attention at docs-per-row k ∈ {1,4,16}
+  vs full attention at the same (b, s); reports per-step time and the
+  skipped-tile fraction (also fed into the ``train.attn_tiles_*``
+  telemetry counters so the live/offline goodput meters see it)."""
+  import jax
+  import jax.numpy as jnp
+
+  from lddl_tpu.ops.flash_attention import (count_skippable_tiles,
+                                            flash_attention)
+  from lddl_tpu.telemetry import get_telemetry
+
+  tele = get_telemetry()
+  dev = jax.devices()[0]
+  header = (f'# block-diagonal attention bench on {dev.device_kind}: '
+            f'batch={args.batch} heads={args.heads} '
+            f'head_dim={args.head_dim} bf16, median of {args.trials} scan '
+            'windows; "full" = flash over the whole packed row, "bdiag" = '
+            'flash with segment ids (cross-doc tiles skipped)\n'
+            '# s | k docs | n | full fwd ms | bdiag fwd ms | '
+            'full fwd+bwd ms | bdiag fwd+bwd ms | tiles skipped')
+  lines = [header]
+  print(header, flush=True)
+  for s in [int(x) for x in args.seqs.split(',')]:
+    key = jax.random.key(s)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (args.batch, args.heads, s, args.head_dim)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+    n = max(8, min(256, (4096 * 32) // s))
+    for docs in [int(x) for x in args.docs_per_row.split(',')]:
+      seg_np = ragged_segments(args.batch, s, docs)
+      seg = jnp.asarray(seg_np)
+      total, skipped = count_skippable_tiles(seg_np)
+      if tele.enabled:
+        tele.counter('train.attn_tiles_total').add(total)
+        tele.counter('train.attn_tiles_skipped').add(skipped)
+
+      def bdiag(q, k, v, _seg=seg):
+        return flash_attention(q, k, v, None, _seg, _seg)
+
+      cells = []
+      for make, fn in ((_make_scanned_fwd, flash_attention),
+                       (_make_scanned_fwd, bdiag),
+                       (_make_scanned_bwd, flash_attention),
+                       (_make_scanned_bwd, bdiag)):
+        try:
+          run = make(fn, n)
+          cells.append(
+              f'{_time_per_step(run, n, q, k, v, trials=args.trials):8.2f}')
+        except Exception as e:  # noqa: BLE001 — OOM is the datapoint here
+          msg = str(e)
+          if ('RESOURCE_EXHAUSTED' in msg or 'Ran out of memory' in msg
+              or 'hbm capacity' in msg):
+            cells.append('     OOM')
+          else:
+            print(f'ERR at s={s} k={docs}: {msg[:500]}', file=sys.stderr,
+                  flush=True)
+            cells.append('     ERR')
+      row = (f'{s:6d} | {docs:2d} | {n:3d} | ' + ' | '.join(cells) +
+             f' | {skipped}/{total} ({skipped / total:.1%})')
+      lines.append(row)
+      print(row, flush=True)
+  text = '\n'.join(lines) + '\n'
+  if args.out:
+    with open(args.out, 'w', encoding='utf-8') as f:
+      f.write(text)
+
+
 def main(argv=None):
   p = argparse.ArgumentParser(description=__doc__)
   p.add_argument('--batch', type=int, default=8)
@@ -96,8 +185,16 @@ def main(argv=None):
   p.add_argument('--head-dim', type=int, default=64)
   p.add_argument('--seqs', default='512,1024,2048,4096,8192,16384')
   p.add_argument('--trials', type=int, default=5)
+  p.add_argument('--block-diagonal', action='store_true',
+                 help='time packed-row block-diagonal attention (segment-id '
+                 'tile skipping) vs full attention at the same shapes')
+  p.add_argument('--docs-per-row', default='1,4,16',
+                 help='--block-diagonal: comma list of docs packed per row')
   p.add_argument('--out', default=None)
   args = p.parse_args(argv)
+
+  if args.block_diagonal:
+    return _run_block_diagonal(args)
 
   import jax
   import jax.numpy as jnp

@@ -27,9 +27,11 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def _synthetic_batch(rng, batch, seq_len, vocab, max_predictions):
+def _synthetic_batch(rng, batch, seq_len, vocab, max_predictions,
+                     docs_per_row=None):
   from lddl_tpu.loader.bert import IGNORE_INDEX
   n_mask = max_predictions
   ids = rng.integers(5, vocab, (batch, seq_len), dtype=np.int32)
@@ -38,16 +40,21 @@ def _synthetic_batch(rng, batch, seq_len, vocab, max_predictions):
     pos = rng.choice(np.arange(1, seq_len - 1), size=n_mask, replace=False)
     labels[b, pos] = ids[b, pos]
     ids[b, pos] = 4  # [MASK]
-  return {
+  out = {
       'input_ids': ids,
       'token_type_ids': np.zeros((batch, seq_len), np.int32),
       'attention_mask': np.ones((batch, seq_len), np.int32),
       'labels': labels,
       'next_sentence_labels': rng.integers(0, 2, (batch,), dtype=np.int32),
   }
+  if docs_per_row is not None:
+    from attention_bench import ragged_segments
+    out['segment_ids'] = ragged_segments(batch, seq_len, docs_per_row,
+                                         seed=int(rng.integers(1 << 30)))
+  return out
 
 
-def _drain_packed(args, s):
+def _drain_packed(args, s, block_diagonal=False):
   """scan_steps real batches of exactly width s from the packed loader.
 
   Full-width rows live in the top bin; the loader streams raw rows and
@@ -71,7 +78,7 @@ def _drain_packed(args, s):
         f'fill the top bin of s={s} (expected ({s - args.bin_size}, {s}]); '
         'regenerate with --target-seq-length matching --seqs')
   tok = load_bert_tokenizer(vocab_file=args.vocab_file, backend='hf')
-  collate = PackedCollate(tok, base_seed=17)
+  collate = PackedCollate(tok, base_seed=17, block_diagonal=block_diagonal)
   batches = []
   for epoch in range(8):
     dl = get_packed_pretrain_data_loader(
@@ -107,6 +114,14 @@ def main(argv=None):
   p.add_argument('--vocab-file', default=None)
   p.add_argument('--bin-size', type=int, default=2048,
                  help='bin width of the packed shards')
+  p.add_argument('--block-diagonal', action='store_true',
+                 help='attach per-doc segment ids to every batch: '
+                 'block-diagonal attention (cross-doc flash tiles skipped) '
+                 'plus per-doc MLM loss normalization; synthetic batches '
+                 'sweep --docs-per-row, packed data decodes doc_offsets')
+  p.add_argument('--docs-per-row', default='1,4,16',
+                 help='--block-diagonal synthetic mode: comma list of docs '
+                 'packed per row')
   args = p.parse_args(argv)
 
   import jax
@@ -122,12 +137,16 @@ def main(argv=None):
   vocab = 30528
   mesh = make_mesh()
   rng = np.random.default_rng(0)
+  mode = ' block-diagonal' if args.block_diagonal else ''
   lines = [('# long-context single-chip train steps: '
             f'{args.model}, batch={args.batch}, flash+remat+masked-only '
-            f'head, scan={args.scan_steps}, median of {args.windows} '
+            f'head{mode}, scan={args.scan_steps}, median of {args.windows} '
             'windows'),
-           '# s | max_pred | ms/step | tokens/s | result']
+           '# s | k docs | max_pred | ms/step | tokens/s | tiles skipped | '
+           'result']
   print('\n'.join(lines), flush=True)
+  doc_counts = ([int(x) for x in args.docs_per_row.split(',')]
+                if args.block_diagonal and not args.packed_data else [None])
 
   for s in [int(x) for x in args.seqs.split(',')]:
     if args.max_predictions:
@@ -146,46 +165,62 @@ def main(argv=None):
         max_position_embeddings=s, attention_impl='flash', remat=True)
     model = BertForPretraining(cfg)
     tx = optax.adamw(1e-4)
-    try:
-      params = init_params(model, mesh, jax.random.key(7), seq_len=128)
-      opt_state = jax.jit(tx.init, out_shardings=None)(params)
-      scan = make_scan_train_step(model, tx, mesh,
-                                  max_predictions=max_pred)
-      if args.packed_data:
-        batches = _drain_packed(args, s)
-      else:
-        batches = [
-            _synthetic_batch(rng, args.batch, s, vocab, max_pred)
-            for _ in range(args.scan_steps)
-        ]
-      window = stack_batch_window(batches, mesh)
-      key = jax.random.key(11)
-      params2, opt2, metrics = scan(params, opt_state, key, window)
-      float(metrics['loss'])  # sync (compile + first window)
-      times = []
-      for _ in range(args.windows):
-        t0 = time.perf_counter()
-        params2, opt2, metrics = scan(params2, opt2, key, window)
-        float(metrics['loss'])  # device->host sync
-        times.append(time.perf_counter() - t0)
-      ms = float(np.median(times)) * 1000 / args.scan_steps
-      toks = args.batch * s / (ms / 1000)
-      row = f'{s:6d} | {max_pred:6d} | {ms:9.1f} | {toks:9.0f} | ok'
-    except Exception as e:  # noqa: BLE001 — OOM is the datapoint
-      msg = str(e)
-      if ('RESOURCE_EXHAUSTED' in msg or 'Ran out of memory' in msg
-          or 'hbm capacity' in msg):
-        row = f'{s:6d} | {max_pred:6d} |       OOM |       OOM | oom'
-      else:
-        print(f'ERR at s={s}: {msg[:400]}', file=sys.stderr, flush=True)
-        row = f'{s:6d} | {max_pred:6d} |       ERR |       ERR | err'
-    lines.append(row)
-    print(row, flush=True)
-    if args.out:
-      # Rewrite after every row so a hard process kill at a later size
-      # (HBM abort, dropped tunnel) keeps the finished datapoints.
-      with open(args.out, 'w', encoding='utf-8') as f:
-        f.write('\n'.join(lines) + '\n')
+    for docs in doc_counts:
+      kcol = f'{docs:6d}' if docs is not None else '     -'
+      skipcol = '            -'
+      try:
+        params = init_params(model, mesh, jax.random.key(7), seq_len=128)
+        opt_state = jax.jit(tx.init, out_shardings=None)(params)
+        scan = make_scan_train_step(model, tx, mesh,
+                                    max_predictions=max_pred)
+        if args.packed_data:
+          batches = _drain_packed(args, s,
+                                  block_diagonal=args.block_diagonal)
+        else:
+          batches = [
+              _synthetic_batch(rng, args.batch, s, vocab, max_pred,
+                               docs_per_row=docs)
+              for _ in range(args.scan_steps)
+          ]
+        if 'segment_ids' in batches[0]:
+          from lddl_tpu.ops.flash_attention import count_skippable_tiles
+          total = skipped = 0
+          for bb in batches:
+            t_, sk_ = count_skippable_tiles(bb['segment_ids'])
+            total += t_
+            skipped += sk_
+          skipcol = f'{skipped}/{total} ({skipped / total:.0%})'
+        window = stack_batch_window(batches, mesh)
+        key = jax.random.key(11)
+        params2, opt2, metrics = scan(params, opt_state, key, window)
+        float(metrics['loss'])  # sync (compile + first window)
+        times = []
+        for _ in range(args.windows):
+          t0 = time.perf_counter()
+          params2, opt2, metrics = scan(params2, opt2, key, window)
+          float(metrics['loss'])  # device->host sync
+          times.append(time.perf_counter() - t0)
+        ms = float(np.median(times)) * 1000 / args.scan_steps
+        toks = args.batch * s / (ms / 1000)
+        row = (f'{s:6d} | {kcol} | {max_pred:6d} | {ms:9.1f} | '
+               f'{toks:9.0f} | {skipcol} | ok')
+      except Exception as e:  # noqa: BLE001 — OOM is the datapoint
+        msg = str(e)
+        if ('RESOURCE_EXHAUSTED' in msg or 'Ran out of memory' in msg
+            or 'hbm capacity' in msg):
+          row = (f'{s:6d} | {kcol} | {max_pred:6d} |       OOM |       OOM '
+                 f'| {skipcol} | oom')
+        else:
+          print(f'ERR at s={s}: {msg[:400]}', file=sys.stderr, flush=True)
+          row = (f'{s:6d} | {kcol} | {max_pred:6d} |       ERR |       ERR '
+                 f'| {skipcol} | err')
+      lines.append(row)
+      print(row, flush=True)
+      if args.out:
+        # Rewrite after every row so a hard process kill at a later size
+        # (HBM abort, dropped tunnel) keeps the finished datapoints.
+        with open(args.out, 'w', encoding='utf-8') as f:
+          f.write('\n'.join(lines) + '\n')
 
 
 if __name__ == '__main__':

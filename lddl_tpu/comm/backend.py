@@ -320,7 +320,9 @@ def ensure_jax_distributed():
   single-process fallback.
   """
   import jax
-  if jax.distributed.is_initialized():
+
+  from ..core.compat import distributed_is_initialized
+  if distributed_is_initialized():
     return True
   addr = os.environ.get('LDDL_COORDINATOR_ADDRESS')
   if addr:
@@ -349,13 +351,25 @@ def ensure_jax_distributed():
     return False
 
 
+#: Per-collective wait bound on the coordination-service fallback path.
+#: Generous because host-level collectives gate whole pipeline stages
+#: (a rank can legitimately arrive minutes after the first one).
+_KV_TIMEOUT_MS = int(os.environ.get('LDDL_COMM_KV_TIMEOUT_MS', '600000'))
+
+
 class JaxProcessBackend(CommBackend):
   """Host-level collectives over a JAX multi-process (TPU pod) runtime.
 
   Construction initializes ``jax.distributed`` via
   :func:`ensure_jax_distributed` (idempotent), so selecting ``--comm jax``
   in any CLI is sufficient — no separate bootstrap call. Collectives ride
-  XLA's ICI/DCN transport via ``multihost_utils``.
+  XLA's ICI/DCN transport via ``multihost_utils`` — except when the XLA
+  backend has no cross-process collectives at all (the CPU backend: the
+  jit psum under ``multihost_utils`` raises INVALID_ARGUMENT). There the
+  same metadata-sized payloads move through the coordination service's
+  KV store and ``wait_at_barrier``, which exist on every distributed
+  runtime regardless of device platform, so ``--comm jax`` worlds are
+  testable on CPU-only hosts.
   """
 
   def __init__(self, initialize=True):
@@ -363,6 +377,7 @@ class JaxProcessBackend(CommBackend):
     self._jax = jax
     # Collective sequence number for trace-event matching across ranks
     # (all ranks issue the same collective sequence by construction).
+    # The KV fallback also keys its store entries / barrier ids on it.
     self._seq = 0
     if initialize:
       ensure_jax_distributed()
@@ -379,26 +394,52 @@ class JaxProcessBackend(CommBackend):
   def collective_seq(self):
     return self._seq
 
+  def _kv_client(self):
+    """Coordination-service client when XLA can't do the collective."""
+    if self._jax.default_backend() != 'cpu' or self.world_size <= 1:
+      return None
+    from ..core.compat import distributed_client
+    return distributed_client()
+
+  def _kv_allgather(self, payload, seq):
+    """All ranks' bytes via the KV store: set own key, blocking-get all
+    ranks' keys (the blocking get is the synchronization), then a
+    trailing barrier so every rank can delete its own key without
+    racing a slower reader."""
+    client = self._kv_client()
+    base = f'lddl/ag/{seq}'
+    client.key_value_set_bytes(f'{base}/{self.rank}', bytes(payload))
+    out = [
+        client.blocking_key_value_get_bytes(f'{base}/{r}', _KV_TIMEOUT_MS)
+        for r in range(self.world_size)
+    ]
+    client.wait_at_barrier(f'lddl_ag_done_{seq}', _KV_TIMEOUT_MS)
+    client.key_value_delete(f'{base}/{self.rank}')
+    return out
+
   def allgather_object(self, obj):
-    from jax.experimental import multihost_utils
     tele = get_telemetry()
     tracer = get_tracer()
     t_start = time.monotonic() if (tele.enabled or tracer.enabled) else 0.0
     seq = self._seq
     self._seq += 1
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    # Pad to the max payload size across ranks so shapes are uniform.
-    sizes = multihost_utils.process_allgather(
-        np.array([payload.size], dtype=np.int64))
-    max_size = int(np.max(sizes))
-    padded = np.zeros((max_size,), dtype=np.uint8)
-    padded[:payload.size] = payload
-    gathered = multihost_utils.process_allgather(padded)
-    flat_sizes = np.asarray(sizes).reshape(-1)
-    out = [
-        pickle.loads(gathered[r, :int(flat_sizes[r])].tobytes())
-        for r in range(self.world_size)
-    ]
+    if self._kv_client() is not None:
+      out = [pickle.loads(blob) for blob in self._kv_allgather(payload, seq)]
+    else:
+      from jax.experimental import multihost_utils
+      # Pad to the max payload size across ranks so shapes are uniform.
+      sizes = multihost_utils.process_allgather(
+          np.array([payload.size], dtype=np.int64))
+      max_size = int(np.max(sizes))
+      padded = np.zeros((max_size,), dtype=np.uint8)
+      padded[:payload.size] = payload
+      gathered = multihost_utils.process_allgather(padded)
+      flat_sizes = np.asarray(sizes).reshape(-1)
+      out = [
+          pickle.loads(gathered[r, :int(flat_sizes[r])].tobytes())
+          for r in range(self.world_size)
+      ]
     if tele.enabled:
       tele.histogram('comm.allgather_seconds').observe(
           time.monotonic() - t_start)
@@ -409,19 +450,29 @@ class JaxProcessBackend(CommBackend):
     return out
 
   def allreduce_sum(self, array):
+    if self._kv_client() is not None:
+      seq = self._seq
+      self._seq += 1
+      payload = np.frombuffer(pickle.dumps(np.asarray(array)), dtype=np.uint8)
+      rows = [pickle.loads(b) for b in self._kv_allgather(payload, seq)]
+      return np.sum(np.stack(rows, axis=0), axis=0)
     from jax.experimental import multihost_utils
     # process_allgather stacks along a new leading axis (one row per process).
     gathered = multihost_utils.process_allgather(np.asarray(array))
     return np.sum(np.asarray(gathered), axis=0)
 
   def barrier(self):
-    from jax.experimental import multihost_utils
     tracer = get_tracer()
     seq = self._seq
     self._seq += 1
     t0 = time.monotonic() if tracer.enabled else 0.0
     with get_telemetry().histogram('comm.barrier_seconds').time():
-      multihost_utils.sync_global_devices('lddl_tpu_barrier')
+      client = self._kv_client()
+      if client is not None:
+        client.wait_at_barrier(f'lddl_barrier_{seq}', _KV_TIMEOUT_MS)
+      else:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices('lddl_tpu_barrier')
     if tracer.enabled:
       tracer.complete('comm.barrier', t0, time.monotonic() - t0,
                       args={'seq': seq})

@@ -1,0 +1,87 @@
+"""Version shims for the narrow band of jax APIs that moved.
+
+The pipeline targets current jax, but the containers it runs in pin
+whatever the TPU image shipped (0.4.x today). Two APIs this codebase
+uses relocated across that span:
+
+  - ``jax.shard_map`` (top-level since 0.6) vs the original
+    ``jax.experimental.shard_map.shard_map`` — and the replication-check
+    kwarg renamed ``check_rep`` -> ``check_vma`` in the move;
+  - ``jax.distributed.is_initialized()`` (added after 0.4.37); older
+    releases only expose the global client state object.
+
+Every call site goes through this module instead of feature-testing
+inline, so the fallback logic exists exactly once and new call sites
+cannot re-introduce the version skew.
+"""
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check=False):
+  """``jax.shard_map`` with the pre-0.6 fallback.
+
+  ``check`` maps to ``check_vma`` on current jax and ``check_rep`` on
+  the ``jax.experimental.shard_map`` original — same meaning (verify
+  per-output replication claims), renamed in the promotion.
+  """
+  sm = getattr(jax, 'shard_map', None)
+  if sm is not None:
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_vma=check)
+  from jax.experimental.shard_map import shard_map as legacy
+  return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=check)
+
+
+def axis_size(axis_name):
+  """``jax.lax.axis_size`` with the pre-API fallback.
+
+  Both forms return the mesh axis size as a *static* Python int (ring
+  attention builds its ppermute schedule and fori_loop bound from it);
+  on 0.4.x ``jax.core.axis_frame(name)`` returns exactly that int.
+  """
+  ax = getattr(jax.lax, 'axis_size', None)
+  if ax is not None:
+    return ax(axis_name)
+  from jax import core
+  return int(core.axis_frame(axis_name))
+
+
+def _distributed_global_state():
+  """The distributed runtime's state singleton across its relocations.
+
+  Public ``jax.distributed.global_state`` where it exists; 0.4.x keeps
+  it only in ``jax._src.distributed``.
+  """
+  state = getattr(jax.distributed, 'global_state', None)
+  if state is not None:
+    return state
+  from jax._src import distributed
+  return getattr(distributed, 'global_state', None)
+
+
+def distributed_is_initialized():
+  """``jax.distributed.is_initialized()`` with the pre-API fallback.
+
+  Older jax exposes only the global state object; its ``client``
+  attribute is non-None exactly when the distributed runtime is up —
+  the same predicate ``is_initialized`` wraps today.
+  """
+  is_init = getattr(jax.distributed, 'is_initialized', None)
+  if is_init is not None:
+    return bool(is_init())
+  state = _distributed_global_state()
+  return state is not None and getattr(state, 'client', None) is not None
+
+
+def distributed_client():
+  """The coordination-service client of the running distributed runtime.
+
+  Returns the ``DistributedRuntimeClient`` (KV store +
+  ``wait_at_barrier``) when ``jax.distributed`` is up, else None. The
+  comm backend uses it to carry host-level collectives on platforms
+  whose XLA backend has no cross-process collectives (CPU).
+  """
+  state = _distributed_global_state()
+  return getattr(state, 'client', None) if state is not None else None

@@ -14,6 +14,10 @@ Batch dict (static per-bin shapes, like the BERT loader):
   next_sentence_labels: int32 [batch]  (all zero — packed rows carry no
           NSP task; present so the BERT train step consumes the batch
           unchanged)
+  segment_ids: int32 [batch, seq_len]  (only with ``block_diagonal=True``:
+          per-token document index decoded from the stored doc_offsets,
+          -1 on padding — drives block-diagonal attention and per-doc
+          MLM loss normalization)
 
 The collate never re-tokenizes: the np.save-wire id rows deserialize
 straight into the padded batch matrix.
@@ -33,10 +37,11 @@ class PackedCollate:
   """Packed-id rows -> fixed-shape numpy batch dict."""
 
   def __init__(self, tokenizer, mlm_probability=0.15, base_seed=12345,
-               dp_rank=0):
+               dp_rank=0, block_diagonal=False):
     self._mlm_prob = mlm_probability
     self._base_seed = base_seed
     self._dp_rank = dp_rank
+    self._block_diagonal = block_diagonal
     self._cls_id = tokenizer.cls_token_id
     self._sep_id = tokenizer.sep_token_id
     self._mask_id = tokenizer.mask_token_id
@@ -65,10 +70,24 @@ class PackedCollate:
     input_ids[rowi, coli] = flat
     cols = np.arange(seq_len)
     attention_mask = (cols < lens[:, None]).astype(np.int32)
-    # Packed rows are a single contiguous stream: segment ids stay 0 (the
-    # stored doc_offsets support block-diagonal consumers; the default
-    # training recipe attends across the packed row).
+    # token_type_ids stay 0 (no NSP task in packed rows); the per-doc
+    # structure travels in the separate segment_ids key below instead,
+    # so the embedding table keeps its 2-type vocabulary.
     token_type_ids = np.zeros((n, seq_len), dtype=np.int32)
+    segment_ids = None
+    if self._block_diagonal:
+      # Decode the stored doc_offsets wire column into a per-token doc
+      # index (pads = -1). Offsets mark each piece's first token —
+      # including continuation chunks of a split document, which get
+      # their own id (their attention context really is row-local). The
+      # leading [CLS] joins doc 0; each [SEP] trails the doc it closes.
+      segment_ids = np.zeros((n, seq_len), dtype=np.int32)
+      for i, row in enumerate(rows):
+        marks = deserialize_np_array(row['doc_offsets']).astype(np.int64)
+        if marks.shape[0] > 1:
+          segment_ids[i, marks[1:]] = 1
+      np.cumsum(segment_ids, axis=1, out=segment_ids)
+      segment_ids[attention_mask == 0] = -1
     special_mask = ((input_ids == self._cls_id) |
                     (input_ids == self._sep_id) |
                     (attention_mask == 0))
@@ -88,13 +107,16 @@ class PackedCollate:
     if tracer.enabled:
       tracer.complete(f'loader.collate.s{seq_len}', t0,
                       time.monotonic() - t0, args={'step': step, 'rows': n})
-    return {
+    batch = {
         'input_ids': input_ids,
         'token_type_ids': token_type_ids,
         'attention_mask': attention_mask,
         'labels': labels,
         'next_sentence_labels': np.zeros(n, dtype=np.int32),
     }
+    if segment_ids is not None:
+      batch['segment_ids'] = segment_ids
+    return batch
 
 
 def get_packed_pretrain_data_loader(
@@ -120,6 +142,7 @@ def get_packed_pretrain_data_loader(
     log_level=None,
     return_raw_samples=False,
     num_workers=0,
+    block_diagonal=False,
 ):
   """Build the long-context packed loader over a (balanced) shard dir.
 
@@ -157,5 +180,5 @@ def get_packed_pretrain_data_loader(
         backend='hf')
   collate = PackedCollate(
       tokenizer, mlm_probability=mlm_probability, base_seed=base_seed,
-      dp_rank=dp_rank)
+      dp_rank=dp_rank, block_diagonal=block_diagonal)
   return build_pretrain_loader(path, collate, **common)

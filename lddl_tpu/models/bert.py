@@ -76,7 +76,7 @@ class SelfAttention(nn.Module):
   deterministic: bool = True
 
   @nn.compact
-  def __call__(self, x, attention_mask):
+  def __call__(self, x, attention_mask, segment_ids=None):
     cfg, deterministic = self.cfg, self.deterministic
     b, s, _ = x.shape
     heads, hd = cfg.num_heads, cfg.head_dim
@@ -96,22 +96,38 @@ class SelfAttention(nn.Module):
           self.mesh is not None):
       from ..parallel.ring import make_ring_attention
       block_impl = 'flash' if cfg.attention_impl == 'ring_flash' else 'dense'
-      ctx = make_ring_attention(self.mesh, block_impl=block_impl)(
-          q, k, v, attention_mask)
+      attend = make_ring_attention(self.mesh, block_impl=block_impl,
+                                   with_segment_ids=segment_ids is not None)
+      if segment_ids is not None:
+        ctx = attend(q, k, v, attention_mask, segment_ids)
+      else:
+        ctx = attend(q, k, v, attention_mask)
     elif cfg.attention_impl in ('flash', 'ring_flash'):
       # ring_flash without a mesh degenerates to single-chip flash.
       from ..ops.flash_attention import (flash_attention,
                                          make_flash_attention)
       if self.mesh is not None:
-        ctx = make_flash_attention(self.mesh)(q, k, v, attention_mask)
+        attend = make_flash_attention(
+            self.mesh, with_segment_ids=segment_ids is not None)
+        if segment_ids is not None:
+          ctx = attend(q, k, v, attention_mask, segment_ids)
+        else:
+          ctx = attend(q, k, v, attention_mask)
       else:
-        ctx = flash_attention(q, k, v, attention_mask)
+        ctx = flash_attention(q, k, v, attention_mask, segment_ids,
+                              segment_ids)
     else:
       scale = 1.0 / (hd ** 0.5)
       scores = jnp.einsum(
           'bhqd,bhkd->bhqk', q, k,
           preferred_element_type=jnp.float32) * scale
       bias = jnp.where(attention_mask, 0.0, -1e9)[:, None, None, :]
+      if segment_ids is not None:
+        # Same block-diagonal semantics as the flash tile skip — this
+        # additive form keeps flash-vs-dense parity testable on CPU.
+        same_doc = (segment_ids[:, None, :, None] ==
+                    segment_ids[:, None, None, :])
+        bias = bias + jnp.where(same_doc, 0.0, -1e9)
       probs = jax.nn.softmax(scores + bias.astype(jnp.float32), axis=-1)
       ctx = jnp.einsum('bhqk,bhkd->bhqd', probs.astype(cfg.dtype), v)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden_size)
@@ -126,10 +142,10 @@ class Layer(nn.Module):
   deterministic: bool = True
 
   @nn.compact
-  def __call__(self, x, attention_mask):
+  def __call__(self, x, attention_mask, segment_ids=None):
     cfg, deterministic = self.cfg, self.deterministic
     attn = SelfAttention(cfg, self.mesh, deterministic, name='attention')(
-        x, attention_mask)
+        x, attention_mask, segment_ids)
     x = x + attn
     if cfg.ablate != 'norms':
       x = nn.LayerNorm(dtype=cfg.dtype, name='attention_norm')(x)
@@ -151,12 +167,12 @@ class Encoder(nn.Module):
   mesh: Any = None
 
   @nn.compact
-  def __call__(self, x, attention_mask, deterministic):
+  def __call__(self, x, attention_mask, deterministic, segment_ids=None):
     cfg = self.cfg
     block = nn.remat(Layer) if cfg.remat else Layer
 
     def body(layer, carry, _):
-      return layer(carry, attention_mask), None
+      return layer(carry, attention_mask, segment_ids), None
 
     x, _ = nn.scan(
         body,
@@ -196,8 +212,13 @@ class BertForPretraining(nn.Module):
                                (cfg.vocab_size,), jnp.float32)
 
   def __call__(self, input_ids, token_type_ids, attention_mask,
-               deterministic=True, mlm_positions=None):
+               deterministic=True, mlm_positions=None, segment_ids=None):
     """Returns (mlm_logits float32, nsp_logits [b,2] float32).
+
+    ``segment_ids`` int32 ``[b, s]`` (doc index per token, -1 = padding,
+    from the packed loader's ``block_diagonal`` mode) restricts
+    attention block-diagonally to same-document pairs in every layer —
+    dense via an additive bias, flash/ring via kernel tile skipping.
 
     ``mlm_positions=None``: logits over every position, ``[b, s, V]``.
     ``mlm_positions`` int32 ``[b, P]``: the masked-only head — hidden
@@ -215,7 +236,7 @@ class BertForPretraining(nn.Module):
          self.token_type_embeddings(token_type_ids))
     x = self.embed_dropout(self.embed_norm(x), deterministic=deterministic)
     mask = attention_mask.astype(bool)
-    x = self.encoder(x, mask, deterministic)
+    x = self.encoder(x, mask, deterministic, segment_ids)
 
     x_mlm = x
     if mlm_positions is not None:

@@ -32,6 +32,20 @@ across chips and rotates K/V, each chip's local block runs here via
 :func:`flash_attention_with_lse`, and the (out, lse) pair enters ring's
 streaming-softmax merge exactly.
 
+Block-diagonal packed attention: optional per-token ``segment_ids``
+(doc index per token, -1 = padding — the packed loader derives them
+from the stored ``doc_offsets``) restrict attention to within-document
+pairs. Because a packed row's doc ids are monotone, every q/kv block
+covers a contiguous id interval, so a (q-block, kv-block) tile whose
+intervals are disjoint provably contains only masked pairs — the
+kernels *skip* such tiles entirely (``pl.when`` around the whole tile
+body: no MXU issue, no accumulator update), and only boundary-straddling
+tiles pay the elementwise ``q_seg == kv_seg`` additive -1e9 bias on top
+of the key-side padding bias. A row packing k documents therefore runs
+~1/k of its attention tiles instead of computing and masking all of
+them — the "no cross-contamination" masking of arXiv:2107.02027 as a
+speedup rather than a cost.
+
 Differentiation is a ``jax.custom_vjp``: forward saves (out, lse); the
 backward runs two Pallas kernels — dq over q-blocks, (dk, dv) over
 k-blocks — each recomputing P = exp(s - lse) blockwise.
@@ -49,6 +63,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e9
+# Softmax-denominator floor: a q row whose every tile was skipped (only
+# padding rows qualify — a real token always overlaps its own document)
+# ends the kv sweep with l == 0; the floor turns its 0/0 output into an
+# exact 0 (and its lse finite) so the sliced-away row cannot leak NaN
+# into `delta` in the backward pass. Real rows always have l >= 1
+# (softmax includes the row max), so the floor never perturbs them.
+_L_FLOOR = 1e-30
 
 
 def _interpret():
@@ -72,10 +93,18 @@ def _padded_len(s):
 # blocks measured 3-4x slower than 2048-wide at s>=2048) while VMEM use
 # stays modest (2 x block_k x 64 x 2B double-buffered ~= 1 MB at 2048).
 # Env overrides (LDDL_FLASH_BLOCK_{Q,KV_FWD,KV_BWD}) support per-shape
-# retuning without code edits — short sequences want smaller kv blocks.
+# retuning without code edits — short sequences want smaller kv blocks,
+# and block-diagonal packed rows skip at tile granularity, so many small
+# documents per row skip more with smaller kv blocks.
 _BLOCK_Q = int(os.environ.get('LDDL_FLASH_BLOCK_Q', 128))
 _BLOCK_KV_FWD = int(os.environ.get('LDDL_FLASH_BLOCK_KV_FWD', 4096))
 _BLOCK_KV_BWD = int(os.environ.get('LDDL_FLASH_BLOCK_KV_BWD', 2048))
+# Segmented (block-diagonal) runs cap kv blocks finer: a tile can only
+# skip whole, so the skip granularity IS the kv block — a 4096-wide
+# block over a row packing 16 x ~512-token docs straddles ~8 documents
+# and never skips, while 512-wide blocks skip ~7/8 of the grid. The
+# extra per-block overhead is repaid as soon as rows pack >~2 docs.
+_BLOCK_KV_SEG = int(os.environ.get('LDDL_FLASH_BLOCK_KV_SEG', 512))
 
 
 def _kv_blocking(s_kv_pad, cap):
@@ -93,21 +122,53 @@ def _kv_blocking(s_kv_pad, cap):
   return block, block * n_steps
 
 
-def _pad_kv(k, v, bias, padded_kv):
+def _pad_kv(k, v, bias, kv_seg, padded_kv):
   s_kv = k.shape[1]
   if padded_kv == s_kv:
-    return k, v, bias
+    return k, v, bias, kv_seg
   grow = ((0, 0), (0, padded_kv - s_kv), (0, 0))
+  seg_grow = ((0, 0), (0, 0), (0, padded_kv - s_kv))
   return (jnp.pad(k, grow), jnp.pad(v, grow),
-          jnp.pad(bias, ((0, 0), (0, 0), (0, padded_kv - s_kv)),
-                  constant_values=NEG_INF))
+          jnp.pad(bias, seg_grow, constant_values=NEG_INF),
+          None if kv_seg is None else jnp.pad(kv_seg, seg_grow,
+                                              constant_values=-1.0))
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-                m_ref, l_ref, acc_ref, *, scale):
+def _seg_interval(seg):
+  """(lo, hi) of the real (non-padding) segment ids in a tile row.
+
+  Padding entries carry -1: excluding them from ``lo`` (and letting
+  them drag ``hi`` down) makes an all-padding block's interval empty
+  (lo > hi), so it reports disjoint against everything — padding-only
+  tiles skip for free."""
+  real = seg >= 0
+  lo = jnp.min(jnp.where(real, seg, jnp.float32(2**30)))
+  hi = jnp.max(jnp.where(real, seg, jnp.float32(-1)))
+  return lo, hi
+
+
+def _tile_live(qseg_ref, kseg_ref):
+  """Scalar: does this (q-block, kv-block) tile contain any same-doc
+  pair? Doc ids are monotone within a packed row, so each block spans a
+  contiguous id interval and interval overlap is exact."""
+  qlo, qhi = _seg_interval(qseg_ref[0, 0, :])
+  klo, khi = _seg_interval(kseg_ref[0, 0, :])
+  return (qlo <= khi) & (klo <= qhi)
+
+
+def _seg_bias(qseg_ref, kseg_ref):
+  """Elementwise cross-document mask for boundary-straddling tiles."""
+  qseg = qseg_ref[0, 0, :]
+  kseg = kseg_ref[0, 0, :]
+  return jnp.where(qseg[:, None] == kseg[None, :], 0.0, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref,
+                o_ref, lse_ref, m_ref, l_ref, acc_ref, *, scale):
   """Grid (bh, q-blocks, kv-blocks); kv is the innermost (sequential)
   dimension. The running (max, sum, accumulator) lives in VMEM scratch,
   which persists across grid steps: reset on the first kv block,
+  updated by every *live* tile (cross-doc tiles skip the whole body),
   finalized into (o, lse) on the last."""
   j = pl.program_id(2)
 
@@ -117,60 +178,76 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
     l_ref[...] = jnp.zeros_like(l_ref)
     acc_ref[...] = jnp.zeros_like(acc_ref)
 
-  q = q_ref[0].astype(jnp.float32)  # [bq, d]
-  k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
-  v_blk = v_ref[0].astype(jnp.float32)
-  scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-  scores = scores + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
-  m = m_ref[...]
-  m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-  p = jnp.exp(scores - m_new)
-  alpha = jnp.exp(m - m_new)
-  l_new = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-  acc_new = acc_ref[...] * alpha + jnp.dot(p, v_blk,
-                                           preferred_element_type=jnp.float32)
-  m_ref[...] = m_new
-  l_ref[...] = l_new
-  acc_ref[...] = acc_new
+  def _tile():
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v_blk = v_ref[0].astype(jnp.float32)
+    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    scores = scores + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+    if qseg_ref is not None:
+      scores = scores + _seg_bias(qseg_ref, kseg_ref)
+    m = m_ref[...]
+    m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+    p = jnp.exp(scores - m_new)
+    alpha = jnp.exp(m - m_new)
+    m_ref[...] = m_new
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+        p, v_blk, preferred_element_type=jnp.float32)
+
+  if qseg_ref is None:
+    _tile()
+  else:
+    pl.when(_tile_live(qseg_ref, kseg_ref))(_tile)
 
   @pl.when(j == pl.num_programs(2) - 1)
   def _finalize():
-    o_ref[0] = (acc_new / l_new).astype(o_ref.dtype)
-    lse_ref[0] = m_new + jnp.log(l_new)
+    l = jnp.maximum(l_ref[...], _L_FLOOR)
+    o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+    lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_acc_ref, *, scale):
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, do_ref,
+               lse_ref, delta_ref, dq_ref, dq_acc_ref, *, scale):
   """Grid (bh, q-blocks, kv-blocks), kv innermost; dq accumulates in
-  scratch across the kv sweep."""
+  scratch across the kv sweep. Cross-doc tiles contribute exactly zero
+  (P underflows against their -1e9 bias) so they are skipped whole."""
   j = pl.program_id(2)
 
   @pl.when(j == 0)
   def _init():
     dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
-  q = q_ref[0].astype(jnp.float32)
-  do = do_ref[0].astype(jnp.float32)
-  lse = lse_ref[0]      # [bq, 1]
-  delta = delta_ref[0]  # [bq, 1]
-  k_blk = k_ref[0].astype(jnp.float32)
-  v_blk = v_ref[0].astype(jnp.float32)
-  scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-  scores = scores + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
-  p = jnp.exp(scores - lse)
-  dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-  ds = p * (dp - delta)
-  dq_acc = dq_acc_ref[...] + jnp.dot(ds, k_blk,
-                                     preferred_element_type=jnp.float32)
-  dq_acc_ref[...] = dq_acc
+  def _tile():
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]      # [bq, 1]
+    delta = delta_ref[0]  # [bq, 1]
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    scores = scores + bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+    if qseg_ref is not None:
+      scores = scores + _seg_bias(qseg_ref, kseg_ref)
+    p = jnp.exp(scores - lse)
+    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dq_acc_ref[...] = dq_acc_ref[...] + jnp.dot(
+        ds, k_blk, preferred_element_type=jnp.float32)
+
+  if qseg_ref is None:
+    _tile()
+  else:
+    pl.when(_tile_live(qseg_ref, kseg_ref))(_tile)
 
   @pl.when(j == pl.num_programs(2) - 1)
   def _finalize():
-    dq_ref[0] = (dq_acc * scale).astype(dq_ref.dtype)
+    dq_ref[0] = (dq_acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, scale):
+def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, qseg_ref, kseg_ref, do_ref,
+                lse_ref, delta_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                *, scale):
   """Grid (bh, kv-blocks, q-blocks), q innermost; dk/dv accumulate in
   scratch across the q sweep while the (k, v) block stays resident."""
   i = pl.program_id(2)
@@ -180,71 +257,101 @@ def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
     dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
     dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-  k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
-  v_blk = v_ref[0].astype(jnp.float32)
-  bias = bias_ref[0, 0, :].astype(jnp.float32)[None, :]
-  q = q_ref[0].astype(jnp.float32)
-  do = do_ref[0].astype(jnp.float32)
-  lse = lse_ref[0]
-  delta = delta_ref[0]
-  # Rows beyond the real sequence carry lse from padded-q garbage; their
-  # dO is zero (cotangents of padding outputs are never produced by the
-  # loss) so they contribute nothing — but guard exp() overflow anyway.
-  scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-  scores = scores + bias
-  p = jnp.exp(jnp.minimum(scores - lse, 30.0))
-  dv_acc = dv_acc_ref[...] + jnp.dot(p.T, do,
-                                     preferred_element_type=jnp.float32)
-  dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
-  ds = p * (dp - delta)
-  dk_acc = dk_acc_ref[...] + jnp.dot(ds.T, q,
-                                     preferred_element_type=jnp.float32)
-  dk_acc_ref[...] = dk_acc
-  dv_acc_ref[...] = dv_acc
+  def _tile():
+    k_blk = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v_blk = v_ref[0].astype(jnp.float32)
+    bias = bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    # Rows beyond the real sequence carry lse from padded-q garbage; their
+    # dO is zero (cotangents of padding outputs are never produced by the
+    # loss) so they contribute nothing — but guard exp() overflow anyway.
+    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    scores = scores + bias
+    if qseg_ref is not None:
+      scores = scores + _seg_bias(qseg_ref, kseg_ref)
+    p = jnp.exp(jnp.minimum(scores - lse, 30.0))
+    dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
+        p.T, do, preferred_element_type=jnp.float32)
+    dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dk_acc_ref[...] = dk_acc_ref[...] + jnp.dot(
+        ds.T, q, preferred_element_type=jnp.float32)
+
+  if qseg_ref is None:
+    _tile()
+  else:
+    pl.when(_tile_live(qseg_ref, kseg_ref))(_tile)
 
   @pl.when(i == pl.num_programs(2) - 1)
   def _finalize():
-    dk_ref[0] = (dk_acc * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+    dk_ref[0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
+def _plain(kernel):
+  """The segment-free variant of a kernel: same body, no seg refs in the
+  pallas_call signature (and the static ``qseg_ref is None`` branch
+  keeps the whole skip/bias machinery out of the trace)."""
+
+  def wrapped(q_ref, k_ref, v_ref, bias_ref, *rest, **kw):
+    return kernel(q_ref, k_ref, v_ref, bias_ref, None, None, *rest, **kw)
+
+  return wrapped
 
 
 # Layout note for the BlockSpecs below: TPU lowering requires each
 # block's last two dims to be (multiple-of-8, multiple-of-128) or equal
 # to the array dims, so scalar rows ride as trailing-singleton 3-D
-# arrays — bias ``[b, 1, s_kv]``, lse/delta ``[bh, s_q, 1]``.
+# arrays — bias/segment ids ``[b, 1, s]``, lse/delta ``[bh, s_q, 1]``.
 
 
 def _qkv_specs(block_q, block_k, d, heads):
   """Shared specs for the (bh, q-blocks, kv-blocks) grid used by both
   the forward and dq pallas_calls — one point of truth so their block
   shapes and index maps cannot desynchronize. Returns
-  (q_spec, kv_spec, bias_spec, row_spec)."""
+  (q_spec, kv_spec, bias_spec, qseg_spec, row_spec)."""
   q_spec = pl.BlockSpec((1, block_q, d), lambda i, b, j: (i, b, 0))
   kv_spec = pl.BlockSpec((1, block_k, d), lambda i, b, j: (i, j, 0))
   bias_spec = pl.BlockSpec((1, 1, block_k), lambda i, b, j: (i // heads, 0, j))
+  qseg_spec = pl.BlockSpec((1, 1, block_q), lambda i, b, j: (i // heads, 0, b))
   row_spec = pl.BlockSpec((1, block_q, 1), lambda i, b, j: (i, b, 0))
-  return q_spec, kv_spec, bias_spec, row_spec
+  return q_spec, kv_spec, bias_spec, qseg_spec, row_spec
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def _flash_pair(q, k, v, bias, heads):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _flash_pair(q, k, v, bias, q_seg, kv_seg, heads):
   """(out, lse) with gradients defined for both outputs — lse cotangents
   arise when results of separate flash calls are merged downstream (the
-  ring composition's streaming-softmax combine)."""
-  return _flash_fwd_impl(q, k, v, bias, heads)
+  ring composition's streaming-softmax combine). ``q_seg``/``kv_seg``
+  are either both None (full attention) or float32 ``[b, 1, s]`` doc
+  ids (-1 = padding) enabling the block-diagonal tile skip."""
+  return _flash_fwd_impl(q, k, v, bias, q_seg, kv_seg, heads)
 
 
-def _flash_fwd_impl(q, k, v, bias, heads):
+def _flash_fwd_impl(q, k, v, bias, q_seg, kv_seg, heads):
   bh, s_q, d = q.shape
   block_q = min(_BLOCK_Q, s_q)
-  block_k, padded_kv = _kv_blocking(k.shape[1], _BLOCK_KV_FWD)
-  k, v, bias = _pad_kv(k, v, bias, padded_kv)
+  cap = _BLOCK_KV_FWD if q_seg is None else min(_BLOCK_KV_FWD, _BLOCK_KV_SEG)
+  block_k, padded_kv = _kv_blocking(k.shape[1], cap)
+  k, v, bias, kv_seg = _pad_kv(k, v, bias, kv_seg, padded_kv)
   grid = (bh, pl.cdiv(s_q, block_q), pl.cdiv(padded_kv, block_k))
-  q_spec, kv_spec, bias_spec, _ = _qkv_specs(block_q, block_k, d, heads)
+  q_spec, kv_spec, bias_spec, qseg_spec, _ = _qkv_specs(
+      block_q, block_k, d, heads)
+  if q_seg is None:
+    kernel, in_specs = _plain(_fwd_kernel), [q_spec, kv_spec, kv_spec,
+                                             bias_spec]
+    inputs = (q, k, v, bias)
+  else:
+    kernel = _fwd_kernel
+    in_specs = [q_spec, kv_spec, kv_spec, bias_spec, qseg_spec, bias_spec]
+    inputs = (q, k, v, bias, q_seg, kv_seg)
   out, lse = pl.pallas_call(
-      functools.partial(_fwd_kernel, scale=1.0 / d**0.5),
+      functools.partial(kernel, scale=1.0 / d**0.5),
       grid=grid,
-      in_specs=[q_spec, kv_spec, kv_spec, bias_spec],
+      in_specs=in_specs,
       out_specs=[
           pl.BlockSpec((1, block_q, d), lambda i, b, j: (i, b, 0)),
           pl.BlockSpec((1, block_q, 1), lambda i, b, j: (i, b, 0)),
@@ -259,23 +366,24 @@ def _flash_fwd_impl(q, k, v, bias, heads):
           pltpu.VMEM((block_q, d), jnp.float32),
       ],
       interpret=_interpret(),
-  )(q, k, v, bias)
+  )(*inputs)
   return out, lse
 
 
-def _flash_fwd(q, k, v, bias, heads):
-  out, lse = _flash_fwd_impl(q, k, v, bias, heads)
-  return (out, lse), (q, k, v, bias, out, lse)
+def _flash_fwd(q, k, v, bias, q_seg, kv_seg, heads):
+  out, lse = _flash_fwd_impl(q, k, v, bias, q_seg, kv_seg, heads)
+  return (out, lse), (q, k, v, bias, q_seg, kv_seg, out, lse)
 
 
 def _flash_bwd(heads, res, cotangents):
-  q, k, v, bias, out, lse = res
+  q, k, v, bias, q_seg, kv_seg, out, lse = res
   g, g_lse = cotangents
   bh, s_q, d = q.shape
   s_kv = k.shape[1]
   block_q = min(_BLOCK_Q, s_q)
-  block_k, padded_kv = _kv_blocking(s_kv, _BLOCK_KV_BWD)
-  k, v, bias_padded = _pad_kv(k, v, bias, padded_kv)
+  cap = _BLOCK_KV_BWD if q_seg is None else min(_BLOCK_KV_BWD, _BLOCK_KV_SEG)
+  block_k, padded_kv = _kv_blocking(s_kv, cap)
+  k, v, bias_padded, kv_seg_padded = _pad_kv(k, v, bias, kv_seg, padded_kv)
   g = g.astype(q.dtype)
   # d(out)/dS = P(delta-terms); d(lse)/dS = P — so an lse cotangent folds
   # into the shared (dp - delta) factor as delta -= g_lse.
@@ -283,32 +391,52 @@ def _flash_bwd(heads, res, cotangents):
                   axis=-1, keepdims=True)  # [bh, s, 1]
   delta = delta - g_lse.astype(jnp.float32)
   scale = 1.0 / d**0.5
+  segmented = q_seg is not None
 
   # dq: grid (bh, q-blocks, kv-blocks), kv innermost.
-  q_spec, kv_spec, bias_spec, row_blocked = _qkv_specs(
+  q_spec, kv_spec, bias_spec, qseg_spec, row_blocked = _qkv_specs(
       block_q, block_k, d, heads)
+  if segmented:
+    dq_kernel = _dq_kernel
+    dq_specs = [q_spec, kv_spec, kv_spec, bias_spec, qseg_spec, bias_spec,
+                q_spec, row_blocked, row_blocked]
+    dq_inputs = (q, k, v, bias_padded, q_seg, kv_seg_padded, g, lse, delta)
+  else:
+    dq_kernel = _plain(_dq_kernel)
+    dq_specs = [q_spec, kv_spec, kv_spec, bias_spec, q_spec,
+                row_blocked, row_blocked]
+    dq_inputs = (q, k, v, bias_padded, g, lse, delta)
   dq = pl.pallas_call(
-      functools.partial(_dq_kernel, scale=scale),
+      functools.partial(dq_kernel, scale=scale),
       grid=(bh, pl.cdiv(s_q, block_q), pl.cdiv(padded_kv, block_k)),
-      in_specs=[q_spec, kv_spec, kv_spec, bias_spec, q_spec,
-                row_blocked, row_blocked],
+      in_specs=dq_specs,
       out_specs=pl.BlockSpec((1, block_q, d), lambda i, b, j: (i, b, 0)),
       out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
       scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
       interpret=_interpret(),
-  )(q, k, v, bias_padded, g, lse, delta)
+  )(*dq_inputs)
 
   # dk/dv: grid (bh, kv-blocks, q-blocks), q innermost; the (k, v) block
   # stays resident across the q sweep.
   q_by_i = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
   kv_by_j = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
   bias_by_j = pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b // heads, 0, j))
+  qseg_by_i = pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b // heads, 0, i))
   row_by_i = pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0))
+  if segmented:
+    dkv_kernel = _dkv_kernel
+    dkv_specs = [q_by_i, kv_by_j, kv_by_j, bias_by_j, qseg_by_i, bias_by_j,
+                 q_by_i, row_by_i, row_by_i]
+    dkv_inputs = (q, k, v, bias_padded, q_seg, kv_seg_padded, g, lse, delta)
+  else:
+    dkv_kernel = _plain(_dkv_kernel)
+    dkv_specs = [q_by_i, kv_by_j, kv_by_j, bias_by_j, q_by_i,
+                 row_by_i, row_by_i]
+    dkv_inputs = (q, k, v, bias_padded, g, lse, delta)
   dk, dv = pl.pallas_call(
-      functools.partial(_dkv_kernel, scale=scale),
+      functools.partial(dkv_kernel, scale=scale),
       grid=(bh, pl.cdiv(padded_kv, block_k), pl.cdiv(s_q, block_q)),
-      in_specs=[q_by_i, kv_by_j, kv_by_j, bias_by_j, q_by_i,
-                row_by_i, row_by_i],
+      in_specs=dkv_specs,
       out_specs=[kv_by_j, kv_by_j],
       out_shape=[
           jax.ShapeDtypeStruct((bh, padded_kv, d), q.dtype),
@@ -319,14 +447,28 @@ def _flash_bwd(heads, res, cotangents):
           pltpu.VMEM((block_k, d), jnp.float32),
       ],
       interpret=_interpret(),
-  )(q, k, v, bias_padded, g, lse, delta)
-  return dq, dk[:, :s_kv, :], dv[:, :s_kv, :], jnp.zeros_like(bias)
+  )(*dkv_inputs)
+  return (dq, dk[:, :s_kv, :], dv[:, :s_kv, :], jnp.zeros_like(bias),
+          None if q_seg is None else jnp.zeros_like(q_seg),
+          None if kv_seg is None else jnp.zeros_like(kv_seg))
 
 
 _flash_pair.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention_with_lse(q, k, v, attention_mask=None):
+def _prep_segments(segment_ids, s, s_pad):
+  """[b, s] int doc ids -> the kernel's padded float32 [b, 1, s_pad] row
+  (float so the custom_vjp cotangent is an ordinary zeros array; doc
+  ids are < 65536, exact in float32). Pads extend with -1."""
+  seg = jnp.asarray(segment_ids).astype(jnp.float32)[:, None, :]
+  if s_pad != s:
+    seg = jnp.pad(seg, ((0, 0), (0, 0), (0, s_pad - s)),
+                  constant_values=-1.0)
+  return seg
+
+
+def flash_attention_with_lse(q, k, v, attention_mask=None,
+                             q_segment_ids=None, kv_segment_ids=None):
   """Like :func:`flash_attention` but also returns the per-query
   log-sum-exp ``[batch, heads, seq]`` (float32) — the quantity needed to
   exactly merge attention results computed over disjoint key sets (ring
@@ -335,6 +477,9 @@ def flash_attention_with_lse(q, k, v, attention_mask=None):
   """
   b, h, s_q, d = q.shape
   s_kv = k.shape[2]
+  if (q_segment_ids is None) != (kv_segment_ids is None):
+    raise ValueError('q_segment_ids and kv_segment_ids must be given '
+                     'together (self-attention passes the same array)')
   if attention_mask is None:
     bias = jnp.zeros((b, s_kv), jnp.float32)
   else:
@@ -349,33 +494,93 @@ def flash_attention_with_lse(q, k, v, attention_mask=None):
     v = jnp.pad(v, kv_pad)
     bias = jnp.pad(bias, ((0, 0), (0, 0), (0, skv_pad - s_kv)),
                    constant_values=NEG_INF)
+  q_seg = kv_seg = None
+  if q_segment_ids is not None:
+    q_seg = _prep_segments(q_segment_ids, s_q, sq_pad)
+    kv_seg = _prep_segments(kv_segment_ids, s_kv, skv_pad)
   out, lse = _flash_pair(q.reshape(b * h, sq_pad, d),
                          k.reshape(b * h, skv_pad, d),
-                         v.reshape(b * h, skv_pad, d), bias, h)
+                         v.reshape(b * h, skv_pad, d), bias, q_seg, kv_seg,
+                         h)
   out = out.reshape(b, h, sq_pad, d)[:, :, :s_q, :]
   lse = lse.reshape(b, h, sq_pad)[:, :, :s_q]
   return out, lse
 
 
-def flash_attention(q, k, v, attention_mask=None):
+def flash_attention(q, k, v, attention_mask=None, q_segment_ids=None,
+                    kv_segment_ids=None):
   """Blockwise-softmax attention; drop-in for the dense einsum path.
 
   ``q, k, v``: ``[batch, heads, seq, head_dim]``; ``attention_mask``:
-  ``[batch, seq]`` with 1 = attend, 0 = padding (key side). Returns the
-  context ``[batch, heads, seq, head_dim]`` in the input dtype.
+  ``[batch, seq]`` with 1 = attend, 0 = padding (key side). Optional
+  ``q_segment_ids``/``kv_segment_ids`` ``[batch, seq]`` int32 (doc index
+  per token, -1 = padding) restrict attention block-diagonally to
+  same-document pairs, skipping provably cross-document tiles (see
+  module docstring). Returns the context ``[batch, heads, seq,
+  head_dim]`` in the input dtype.
   """
-  return flash_attention_with_lse(q, k, v, attention_mask)[0]
+  return flash_attention_with_lse(q, k, v, attention_mask, q_segment_ids,
+                                  kv_segment_ids)[0]
 
 
-def make_flash_attention(mesh, q_spec=None, mask_spec=None):
+def segment_block_intervals(segment_ids, block):
+  """Per-block (lo, hi) doc-id intervals of a ``[b, s]`` id array —
+  numpy, the host-side mirror of the kernel's ``_seg_interval``. The
+  array is padded with -1 up to a whole number of blocks."""
+  import numpy as np
+  seg = np.asarray(segment_ids)
+  b, s = seg.shape
+  s_pad = -(-s // block) * block
+  if s_pad != s:
+    seg = np.pad(seg, ((0, 0), (0, s_pad - s)), constant_values=-1)
+  tiles = seg.reshape(b, s_pad // block, block)
+  real = tiles >= 0
+  lo = np.where(real, tiles, 2**30).min(axis=2)
+  hi = np.where(real, tiles, -1).max(axis=2)
+  return lo, hi
+
+
+def count_skippable_tiles(segment_ids, block_q=None, block_k=None):
+  """(total, skipped) forward-grid tile counts for a ``[b, s]``
+  segment-id batch under the kernel's interval-disjointness rule — the
+  exact host-side account of the tiles the Pallas grid will skip (per
+  (batch, q-block, kv-block); multiply by heads for per-head counts;
+  the fraction is heads-invariant). Feeds the ``train.attn_tiles_*``
+  telemetry counters and the benchmark skip-fraction columns."""
+  s = int(segment_ids.shape[1])
+  s_pad = _padded_len(s)
+  if block_q is None:
+    block_q = min(_BLOCK_Q, s_pad)
+  if block_k is None:
+    block_k, s_pad = _kv_blocking(s_pad, min(_BLOCK_KV_FWD, _BLOCK_KV_SEG))
+  import numpy as np
+  seg = np.asarray(segment_ids)
+  if s_pad != s:
+    seg = np.pad(seg, ((0, 0), (0, s_pad - s)), constant_values=-1)
+  qlo, qhi = segment_block_intervals(seg, block_q)
+  klo, khi = segment_block_intervals(seg, block_k)
+  live = ((qlo[:, :, None] <= khi[:, None, :]) &
+          (klo[:, None, :] <= qhi[:, :, None]))
+  total = int(live.size)
+  return total, total - int(live.sum())
+
+
+def make_flash_attention(mesh, q_spec=None, mask_spec=None,
+                         with_segment_ids=False):
   """Wrap :func:`flash_attention` in ``shard_map`` for jitted use over a
   mesh: batch over (data, fsdp), heads over tensor — a ``pallas_call``
   has no GSPMD partitioning rule, so without this the compiler would
   replicate q/k/v onto every chip. The sequence axis must be unsharded
   (flash is per-chip block math; sequence sharding is ring attention's
   job — use ``attention_impl='ring_flash'`` for both).
+
+  ``with_segment_ids=True`` returns a wrapper taking an extra
+  ``segment_ids`` ``[batch, seq]`` operand (used for both q and kv —
+  self-attention), sharded like the mask.
   """
   from jax.sharding import PartitionSpec as P
+
+  from ..core.compat import shard_map
   if dict(zip(mesh.axis_names, mesh.devices.shape)).get('seq', 1) > 1:
     raise ValueError(
         "flash attention does not shard the sequence axis; use "
@@ -386,12 +591,24 @@ def make_flash_attention(mesh, q_spec=None, mask_spec=None):
   q_spec = q_spec or P(batch_axes, head_axis, None, None)
   mask_spec = mask_spec or P(batch_axes, None)
 
+  if with_segment_ids:
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(q_spec, q_spec, q_spec, mask_spec, mask_spec),
+        out_specs=q_spec,
+        check=False)
+    def _sharded_seg(q, k, v, mask, segment_ids):
+      return flash_attention(q, k, v, mask, segment_ids, segment_ids)
+
+    return _sharded_seg
+
   @functools.partial(
-      jax.shard_map,
+      shard_map,
       mesh=mesh,
       in_specs=(q_spec, q_spec, q_spec, mask_spec),
       out_specs=q_spec,
-      check_vma=False)
+      check=False)
   def _sharded(q, k, v, mask):
     return flash_attention(q, k, v, mask)
 

@@ -55,6 +55,35 @@ def init_params(model, mesh, rng, seq_len=128, batch=2):
   return jax.jit(init_fn, out_shardings=shardings)()
 
 
+def per_doc_mlm_loss(mlm_ce, masked, seg, num_docs_cap):
+  """Packing-aware MLM normalization (arXiv:2107.02027 §3.2).
+
+  The naive packed loss is a masked-token mean over the whole batch,
+  which weights a document by its masked-token count — long documents
+  dominate, and the objective drifts from what the same documents would
+  contribute trained unpacked (each sequence normalized by its own mask
+  count). Here each document contributes its own masked-mean CE and the
+  batch loss is the mean over documents with >= 1 MLM target, so packed
+  and unpacked training optimize the same per-sequence objective.
+
+  ``seg``: doc index per column (aligned with ``mlm_ce``/``masked``;
+  callers using the masked-only head gather it at ``mlm_positions``).
+  ``num_docs_cap``: static upper bound on docs per row (the sequence
+  length serves — doc ids are strictly below it).
+  """
+  b = masked.shape[0]
+  ids = (jnp.clip(seg, 0, num_docs_cap - 1) +
+         num_docs_cap * jnp.arange(b, dtype=seg.dtype)[:, None])
+  w = masked.astype(jnp.float32).reshape(-1)
+  ce_sum = jax.ops.segment_sum(mlm_ce.reshape(-1) * w, ids.reshape(-1),
+                               num_segments=b * num_docs_cap)
+  cnt = jax.ops.segment_sum(w, ids.reshape(-1),
+                            num_segments=b * num_docs_cap)
+  has = cnt > 0
+  per_doc = jnp.where(has, ce_sum / jnp.maximum(cnt, 1.0), 0.0)
+  return per_doc.sum() / jnp.maximum(has.sum(), 1)
+
+
 def pretrain_loss(model, params, batch, dropout_rng=None,
                   max_predictions=None):
   """Scalar loss + metrics dict for one batch.
@@ -68,6 +97,10 @@ def pretrain_loss(model, params, batch, dropout_rng=None,
   dynamic masking is Bernoulli per position, so rows in the far binomial
   tail (> P masked) would silently drop their overflow targets — size P
   with headroom there.
+
+  A ``segment_ids`` batch key (packed loader, ``block_diagonal=True``)
+  switches on both block-diagonal attention in the model and the
+  :func:`per_doc_mlm_loss` normalization.
   """
   deterministic = dropout_rng is None
   rngs = None if deterministic else {'dropout': dropout_rng}
@@ -82,6 +115,7 @@ def pretrain_loss(model, params, batch, dropout_rng=None,
         labels == IGNORE_INDEX, axis=1, stable=True,
     )[:, :p].astype(jnp.int32)
     labels = jnp.take_along_axis(labels, mlm_positions, axis=1)
+  segment_ids = batch.get('segment_ids')
   mlm_logits, nsp_logits = model.apply(
       {'params': params},
       batch['input_ids'],
@@ -89,13 +123,21 @@ def pretrain_loss(model, params, batch, dropout_rng=None,
       batch['attention_mask'],
       deterministic=deterministic,
       mlm_positions=mlm_positions,
+      segment_ids=segment_ids,
       rngs=rngs)
   masked = labels != IGNORE_INDEX
   safe_labels = jnp.where(masked, labels, 0)
   mlm_ce = optax.softmax_cross_entropy_with_integer_labels(
       mlm_logits, safe_labels)
   denom = jnp.maximum(masked.sum(), 1)
-  mlm_loss = jnp.where(masked, mlm_ce, 0.0).sum() / denom
+  if segment_ids is not None:
+    seg = segment_ids
+    if mlm_positions is not None:
+      seg = jnp.take_along_axis(segment_ids, mlm_positions, axis=1)
+    mlm_loss = per_doc_mlm_loss(mlm_ce, masked, seg,
+                                num_docs_cap=batch['input_ids'].shape[1])
+  else:
+    mlm_loss = jnp.where(masked, mlm_ce, 0.0).sum() / denom
   nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
       nsp_logits, batch['next_sentence_labels']).mean()
   mlm_acc = jnp.where(masked,

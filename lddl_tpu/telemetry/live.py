@@ -169,6 +169,10 @@ def goodput_meters(merged):
     - ``step_cache_hit_rate``: warm-executable fraction of train steps;
     - ``h2d_overlap_fraction``: 1 - data_wait/h2d — how much of the
       host-to-device transfer hides behind compute;
+    - ``attn_tile_skip_fraction``: fraction of attention-grid tiles the
+      block-diagonal packed path skipped outright (cross-document
+      tiles the flash/ring kernels never compute) — 0 under full
+      attention, approaches (k-1)/k at k docs per packed row;
     - ``queue_depth`` / ``shm_slot_occupancy`` / ``writer_backlog``:
       backpressure gauges (mean/min/max) from the loader transport and
       the async shard writer.
@@ -209,6 +213,10 @@ def goodput_meters(merged):
     out['h2d_overlap_fraction'] = max(0.0, min(1.0, 1.0 - wait / h2d))
   else:
     out['h2d_overlap_fraction'] = None
+
+  tiles = _counter_total(metrics, 'train.attn_tiles_total')
+  skipped = _counter_total(metrics, 'train.attn_tiles_skipped')
+  out['attn_tile_skip_fraction'] = skipped / tiles if tiles else None
 
   out['queue_depth'] = _gauge(metrics, 'loader.queue_depth')
   out['shm_slot_occupancy'] = _gauge(metrics, 'loader.shm_slot_occupancy')

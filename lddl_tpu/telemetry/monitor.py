@@ -143,6 +143,8 @@ def render_frame(fleet, clear=True):
       meters.append(f'step-cache {good["step_cache_hit_rate"]:.1%}')
     if good.get('h2d_overlap_fraction') is not None:
       meters.append(f'h2d-overlap {good["h2d_overlap_fraction"]:.1%}')
+    if good.get('attn_tile_skip_fraction') is not None:
+      meters.append(f'attn-tiles-skipped {good["attn_tile_skip_fraction"]:.1%}')
     for g in ('queue_depth', 'shm_slot_occupancy'):
       if good.get(g):
         meters.append(f'{g} {good[g]["mean"]:.1f}')

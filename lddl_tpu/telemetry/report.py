@@ -266,6 +266,12 @@ def render_report(merged):
     if tput and tput.get('count'):
       out.append(f'  throughput: {tput["mean"]:.1f} samples/s '
                  f'(max {tput["max"]:.1f})')
+    tiles = metrics.get('train.attn_tiles_total', {}).get('total', 0)
+    if tiles:
+      skipped = metrics.get('train.attn_tiles_skipped',
+                            {}).get('total', 0)
+      out.append(f'  attention tiles: {tiles} total, {skipped} skipped '
+                 f'({100 * skipped / tiles:.1f}% block-diagonal skip)')
 
   verdict = summarize_stages(merged)
   out.append('\n[bottleneck]')

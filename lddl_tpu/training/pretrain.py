@@ -143,7 +143,8 @@ class TrainLoop:
             warmup_steps=100, total_steps=10000, weight_decay=0.01,
             batch_size_per_rank=64, bin_size=None, max_seq_length=512,
             masking='dynamic', seed=127, samples_seen=0, loader_kwargs=None,
-            max_predictions=None, data_format='pairs'):
+            max_predictions=None, data_format='pairs',
+            block_diagonal=False):
     import jax
     import optax
 
@@ -158,6 +159,9 @@ class TrainLoop:
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
     tx = optax.adamw(schedule, weight_decay=weight_decay)
     dp_rank, dp_world = jax.process_index(), jax.process_count()
+    if block_diagonal and data_format != 'packed':
+      raise ValueError("block_diagonal requires data_format='packed' "
+                       '(pair shards carry no doc_offsets)')
     if data_format == 'packed':
       # Long-context document-packed shards (preprocess_packed_pretrain):
       # always dynamic masking, no NSP pairs.
@@ -174,6 +178,7 @@ class TrainLoop:
           bin_size=bin_size,
           base_seed=seed,
           samples_seen=samples_seen,
+          block_diagonal=block_diagonal,
           **(loader_kwargs or {}))
     else:
       loader = get_bert_pretrain_data_loader(
@@ -318,6 +323,8 @@ class TrainLoop:
     step_h = tele.histogram('train.step_seconds')
     steps_c = tele.counter('train.steps')
     samples_c = tele.counter('train.samples')
+    tiles_total_c = tele.counter('train.attn_tiles_total')
+    tiles_skipped_c = tele.counter('train.attn_tiles_skipped')
     peak_total = _peak_flops_total() if tele.enabled else None
     if _step_cache_enabled() and not isinstance(self.step_fn,
                                                 CompiledStepCache):
@@ -376,6 +383,17 @@ class TrainLoop:
             tele.gauge('train.mfu').set(
                 self.flops_fn(b, s) /
                 (max(now - t_wait, 1e-9) * peak_total))
+          if 'segment_ids' in batch:
+            # Host-side mirror of the kernel's tile-skip rule: the
+            # goodput signal for how much attention work block-diagonal
+            # packing actually removed this step.
+            import numpy as np
+
+            from ..ops.flash_attention import count_skippable_tiles
+            total, skipped = count_skippable_tiles(
+                np.asarray(batch['segment_ids']))
+            tiles_total_c.add(total)
+            tiles_skipped_c.add(skipped)
         if log_every and self.step % log_every == 0:
           dt = time.perf_counter() - t0
           t0 = time.perf_counter()
@@ -487,6 +505,12 @@ def attach_args(parser):
                       help="'pairs': NSP-pair shards (preprocess_bert_"
                       "pretrain); 'packed': long-context document-packed "
                       'id shards (preprocess_packed_pretrain, s=8k-32k)')
+  parser.add_argument('--block-diagonal', action='store_true',
+                      help="packed rows only: decode per-doc segment ids "
+                      'from the stored doc_offsets, restrict attention to '
+                      'within-document pairs (flash/ring skip cross-doc '
+                      'tiles), and normalize the MLM loss per document '
+                      '(arXiv:2107.02027)')
   parser.add_argument('--steps', type=int, default=1000)
   parser.add_argument('--learning-rate', type=float, default=1e-4)
   parser.add_argument('--warmup-steps', type=int, default=100)
@@ -564,7 +588,8 @@ def main(args=None):
       max_seq_length=args.max_seq_length, masking=args.masking,
       seed=args.seed, samples_seen=samples_seen,
       max_predictions=args.max_predictions,
-      data_format=args.data_format)
+      data_format=args.data_format,
+      block_diagonal=args.block_diagonal)
   if resume:
     loop.restore(args.checkpoint_dir)
   losses = loop.run(args.steps, ckpt_dir=args.checkpoint_dir,
