@@ -141,8 +141,9 @@ def main():
     import jax
     num_chips = max(1, len(jax.devices()))
 
+    from lddl_tpu.comm import comm_heartbeat_interval
     from lddl_tpu.loader.workers import _resolve_transport, _resolve_zero_copy
-    from lddl_tpu.pipeline.executor import Executor
+    from lddl_tpu.pipeline.executor import Executor, lease_timeout
     from lddl_tpu.preprocess.bert import BertPretrainConfig, run
     from lddl_tpu.preprocess.common import native_columnar_enabled
     from lddl_tpu.preprocess.readers import read_corpus
@@ -245,6 +246,19 @@ def main():
             'block_diagonal'
             if os.environ.get('LDDL_BENCH_BLOCK_DIAGONAL', '') not in
             ('', '0', 'false', 'off', 'no') else 'full',
+        # Fault-tolerance/resume regime during the measurement: the
+        # elastic lease-claimed scheduler pays a (tiny) heartbeat +
+        # claim-CAS cost the static stride does not, so a BENCH line is
+        # not comparable across these settings either.
+        'fault_tolerance': {
+            'elastic': executor.scheduler_info().get('elastic', False),
+            'lease_timeout_sec': lease_timeout(),
+            'heartbeat_sec': comm_heartbeat_interval(),
+        },
+        'resume': {
+            'resumable': executor.scheduler_info().get('elastic', False),
+            'run_id': getattr(executor.comm, '_run_id', None),
+        },
     }
     result.update(_telemetry_artifacts())
     result.update(_lint_status())

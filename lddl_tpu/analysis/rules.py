@@ -412,6 +412,77 @@ class PoolChurn(Rule):
         'instead of reusing a pool with a lifetime (pool churn)', ctx)
 
 
+# ---------------------------------------------------------------------------
+# LDA007: swallowed exceptions
+
+
+_BROAD_EXC = frozenset({'Exception', 'BaseException'})
+
+
+class SwallowedException(Rule):
+  rule_id = 'LDA007'
+  name = 'swallowed-exception'
+  invariant = ('fault-tolerance code must never eat errors blindly: a '
+               'bare/broad except whose body does nothing turns rank '
+               'death, lease races, and IO corruption into silent wrong '
+               'answers the recovery machinery can no longer see')
+  hint = ('catch the narrow exception the site actually expects '
+          '(OSError, FileExistsError, ...), or handle it: count it in '
+          'telemetry, log it, or re-raise — if swallowing broadly is '
+          'truly intended, annotate why with  # lddl: noqa[LDA007]')
+
+  def exempt(self, ctx):
+    # Tests exercise failure paths on purpose (and often probe with
+    # deliberately broad catches).
+    if ctx.path_is('tests/'):
+      return True
+    base = ctx.basename()
+    return (base.startswith('test_') or
+            base in ('conftest.py', 'testing.py'))
+
+  def _is_broad(self, node, ctx):
+    if node.type is None:
+      return True  # bare `except:`
+    types = (node.type.elts if isinstance(node.type, ast.Tuple)
+             else [node.type])
+    for t in types:
+      name = None
+      if isinstance(t, ast.Name):
+        name = t.id
+      elif isinstance(t, ast.Attribute):
+        name = t.attr
+      if name in _BROAD_EXC:
+        return True
+    return False
+
+  def _is_inert(self, body):
+    # pass / continue / `...` / a lone docstring: nothing observed the
+    # error. A `return`/assignment/call/raise counts as handling.
+    for stmt in body:
+      if isinstance(stmt, (ast.Pass, ast.Continue)):
+        continue
+      if (isinstance(stmt, ast.Expr) and
+          isinstance(stmt.value, ast.Constant) and
+          (stmt.value.value is Ellipsis or
+           isinstance(stmt.value.value, str))):
+        continue
+      return False
+    return True
+
+  def on_node(self, node, ctx):
+    if not isinstance(node, ast.ExceptHandler):
+      return
+    if not self._is_broad(node, ctx) or not self._is_inert(node.body):
+      return
+    what = ('bare except:' if node.type is None else
+            'except ' + ast.unparse(node.type) + ':')
+    yield self.finding(
+        node, f'{what} with a do-nothing body swallows every error '
+        '(including rank death, lease races, and IO corruption) '
+        'invisibly — catch the narrow exception the site expects, or '
+        'observe the failure (telemetry/log/re-raise)', ctx)
+
+
 def default_rules():
   """Fresh instances of every shipped rule, in rule-id order."""
   return [
@@ -421,6 +492,7 @@ def default_rules():
       UnscopedResource(),
       RankConditionalCollective(),
       PoolChurn(),
+      SwallowedException(),
   ]
 
 

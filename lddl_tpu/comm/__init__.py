@@ -1,8 +1,13 @@
 from .backend import (
     CommBackend,
     FileBackend,
+    FileLeaseStore,
     JaxProcessBackend,
+    KVLeaseStore,
+    LeaseStore,
     NullBackend,
+    comm_heartbeat_interval,
+    comm_timeout,
     ensure_jax_distributed,
     get_backend,
 )

@@ -25,12 +25,64 @@ import time
 
 import numpy as np
 
+from ..core import faults
 from ..telemetry import get_telemetry
 from ..telemetry.trace import get_tracer
 
 
+def comm_timeout(default=120.0):
+  """Collective timeout in seconds (env ``LDDL_COMM_TIMEOUT``)."""
+  try:
+    return float(os.environ.get('LDDL_COMM_TIMEOUT', default))
+  except ValueError:
+    return default
+
+
+def comm_heartbeat_interval(default=1.0):
+  """Liveness cadence in seconds (env ``LDDL_COMM_HEARTBEAT``): how often
+  FileBackend probes a silent peer's death beacon while waiting, and how
+  often the executor's lease heartbeat pump republishes its counter.
+  Probing more or less often changes only failure-detection latency,
+  never any result."""
+  try:
+    return max(0.05, float(os.environ.get('LDDL_COMM_HEARTBEAT', default)))
+  except ValueError:
+    return default
+
+
+def _retry_io(fn, what, retries=3, base_delay=0.01):
+  """Run ``fn()`` retrying transient ``OSError`` with bounded backoff.
+
+  Shared filesystems (the FileBackend's whole substrate) throw spurious
+  EIO/ESTALE/ENOENT during rename races and NFS attribute-cache misses;
+  one failed stat must not abort a run the lease protocol could finish.
+  Bounded: a persistent error still surfaces, with the original
+  traceback, after ``retries`` attempts.
+  """
+  for attempt in range(retries + 1):
+    try:
+      return fn()
+    except OSError:
+      if attempt == retries:
+        raise
+      get_telemetry().counter('comm.io_retries').add(1)
+      time.sleep(base_delay * (2 ** attempt))
+
+
 class CommBackend:
   """Protocol: rank/world_size + tiny-metadata collectives."""
+
+  #: Whether the elastic lease-claimed executor path should use this
+  #: backend's lease store by default (LDDL_ELASTIC=auto). True only
+  #: where the claim/heartbeat substrate is first-class (FileBackend).
+  elastic_default = False
+
+  def lease_store(self, namespace):
+    """A :class:`LeaseStore` over this backend's substrate for one map
+    phase (``namespace`` must be identical across ranks), or None when
+    the backend has no CAS/KV substrate — the executor then falls back
+    to the static stride."""
+    return None
 
   @property
   def rank(self):
@@ -95,13 +147,19 @@ class FileBackend(CommBackend):
   shared FS — TPU pods should use :class:`JaxProcessBackend`.
   """
 
-  def __init__(self, rendezvous_dir, rank, world_size, timeout=120.0,
+  elastic_default = True
+
+  def __init__(self, rendezvous_dir, rank, world_size, timeout=None,
                poll_interval=0.005, run_id=None):
     self._dir = rendezvous_dir
     os.makedirs(rendezvous_dir, exist_ok=True)
     self._rank = rank
     self._world_size = world_size
-    self._timeout = timeout
+    # Explicit ctor args win; otherwise env-tunable (LDDL_COMM_TIMEOUT /
+    # LDDL_COMM_HEARTBEAT) so a slow shared mount can stretch both the
+    # collective deadline and the liveness cadence without code changes.
+    self._timeout = comm_timeout() if timeout is None else timeout
+    self._liveness_interval = comm_heartbeat_interval()
     self._poll = poll_interval
     self._seq = 0
     self._gc_upto = 0  # own op files below this seq have been deleted
@@ -188,21 +246,30 @@ class FileBackend(CommBackend):
       return True
     return bool(starttime) and cls._pid_starttime(pid) not in ('', starttime)
 
+  def peer_positively_dead(self, r):
+    """Positive death probe for rank ``r`` via its liveness beacon: True
+    only when the beacon names a same-pid-namespace process that is
+    provably gone (or a zombie, or a reused pid). Missing beacon,
+    foreign namespace, or any probe error all return False — absence of
+    proof is never treated as death. Shared by the collective fail-fast
+    path and the lease stores' stale-owner revocation."""
+    try:
+      with open(self._alive_path(r), 'rb') as f:
+        pid_s, pidns, starttime = f.read().decode().split('@', 2)
+      if not self._pidns or pidns != self._pidns or not pid_s.isdigit():
+        return False
+      return self._pid_dead(int(pid_s), starttime)
+    except Exception:
+      return False  # beacon unreadable / not started yet: timeout rules
+
   def _check_peer_alive(self, r, seq):
     """Raise (naming the rank) when a same-pid-namespace peer's process
     is dead. Only a *positive* death signal raises: a missing or
     foreign-namespace beacon, or any probe error, keeps the normal
     timeout path.
     """
-    try:
-      with open(self._alive_path(r), 'rb') as f:
-        pid_s, pidns, starttime = f.read().decode().split('@', 2)
-      if not self._pidns or pidns != self._pidns or not pid_s.isdigit():
-        return
-      dead = self._pid_dead(int(pid_s), starttime)
-    except Exception:
-      return  # beacon unreadable / not started yet: timeout rules
-    if dead:
+    if self.peer_positively_dead(r):
+      pid_s = self._beacon_pid(r)
       # Death is only an error if the peer died *without* publishing
       # this collective. A peer whose last act was writing its payload
       # for #seq and exiting cleanly (e.g. last rank of a finishing job)
@@ -215,11 +282,39 @@ class FileBackend(CommBackend):
           f'collective #{seq}; failing fast instead of waiting out the '
           f'{self._timeout:.0f}s timeout (dir={self._dir})')
 
+  def _beacon_pid(self, r):
+    """Rank ``r``'s beacon pid string, for error messages only ('?'
+    when the beacon is unreadable)."""
+    try:
+      with open(self._alive_path(r), 'rb') as f:
+        return f.read().decode().split('@', 2)[0]
+    except (OSError, UnicodeDecodeError):
+      return '?'
+
   def _write_atomic(self, payload, dst):
-    fd, tmp = tempfile.mkstemp(dir=self._dir)
-    with os.fdopen(fd, 'wb') as f:
-      f.write(payload)
-    os.rename(tmp, dst)
+
+    def _attempt():
+      # Inside the retry closure: an injected transient write error must
+      # exercise the same bounded-backoff path a real EIO flap would.
+      faults.inject('comm.write', rank=self._rank)
+      fd, tmp = tempfile.mkstemp(dir=self._dir)
+      with os.fdopen(fd, 'wb') as f:
+        f.write(payload)
+      os.rename(tmp, dst)
+
+    _retry_io(_attempt, f'atomic write {os.path.basename(dst)}')
+
+  def _read_payload(self, path):
+    """Read a published payload file, retrying transient filesystem
+    errors. The file provably exists (we stat-polled it into view), so
+    even a mid-rename ENOENT flap on NFS is transient, not absence."""
+
+    def _attempt():
+      with open(path, 'rb') as f:
+        return f.read()
+
+    return pickle.loads(
+        _retry_io(_attempt, f'payload read {os.path.basename(path)}'))
 
   def _collect_garbage(self, seq):
     """Delete this rank's op files that no peer can still need.
@@ -279,16 +374,15 @@ class FileBackend(CommBackend):
               f'collective #{seq} (dir={self._dir})')
         # lddl: noqa[LDA003] liveness-probe rate limit: probing more or
         # less often changes only failure latency, never the result.
-        if now - last_liveness >= 1.0:  # cheap: one stat + kill(pid, 0)
-          self._check_peer_alive(r, seq)
+        if now - last_liveness >= self._liveness_interval:
+          self._check_peer_alive(r, seq)  # cheap: one stat + /proc read
           last_liveness = now
         time.sleep(delay)
         # Never poll faster than the configured interval: backoff only
         # coarsens waits, it must not override a deliberately slow poll
         # (e.g. a rendezvous dir on NFS).
         delay = min(delay * 2, max(self._poll, 0.05))
-      with open(p, 'rb') as f:
-        results.append(pickle.loads(f.read()))
+      results.append(self._read_payload(p))
     if tele.enabled:
       # Collective latency includes peer wait, so cross-rank spread here
       # is the straggler signal the report surfaces per rank.
@@ -302,6 +396,216 @@ class FileBackend(CommBackend):
       tracer.complete('comm.allgather', t_start,
                       time.monotonic() - t_start, args={'seq': seq})
     return results
+
+  def lease_store(self, namespace):
+    """Lease/claim substrate for one elastic map phase, rooted at
+    ``<rendezvous>/<run_id>.elastic.<namespace>/``. Keyed on run_id like
+    the op files: restarting with the same run_id *resumes* (completion
+    manifests from the previous incarnation are honored), a fresh run_id
+    starts clean."""
+    root = os.path.join(self._dir, f'{self._run_id}.elastic.{namespace}')
+    return FileLeaseStore(root, self._rank,
+                          dead_probe=self.peer_positively_dead)
+
+
+class LeaseStore:
+  """Claim/heartbeat/manifest primitives for one elastic map phase.
+
+  Key grammar (shared by both implementations; ``gi`` = global task
+  index, ``gen`` = revocation generation)::
+
+    claim.<gi>.g<gen>   ascii owner rank       CAS: first writer wins
+    revoke.<gi>.g<gen>  ascii revoker rank     CAS: invalidates <gen>
+    done.<gi>           pickled task result    idempotent atomic publish
+    hb.rank<r>          ascii counter          mutable heartbeat
+
+  Claims and revokes are write-once (CAS) so every rank agrees on one
+  owner per (gi, gen) and one revocation winner; ``done`` manifests and
+  heartbeats are idempotent overwrites. Values never need deletion
+  within a phase — a namespace is cheap and garbage-collects with its
+  rendezvous directory / coordination service.
+  """
+
+  rank = 0
+  #: Directory workers can publish ``done.<gi>`` manifests into via the
+  #: write-back-ordered path (None: only the parent process can publish).
+  manifest_root = None
+
+  def try_claim(self, key):
+    """Atomically create ``key`` owned by this rank. Returns None on
+    success (we own it) or the owning rank (>= 0; -1 when the owner is
+    momentarily unreadable)."""
+    raise NotImplementedError
+
+  def publish(self, key, payload):
+    """Idempotent atomic write of ``payload`` (bytes) at ``key``."""
+    raise NotImplementedError
+
+  def read(self, key):
+    """Payload bytes at ``key``, or None when absent."""
+    raise NotImplementedError
+
+  def list(self, prefix):
+    """Sorted keys in this namespace starting with ``prefix``."""
+    raise NotImplementedError
+
+  def heartbeat(self, value):
+    self.publish(f'hb.rank{self.rank}', str(int(value)).encode())
+
+  def read_heartbeat(self, r):
+    raw = self.read(f'hb.rank{r}')
+    try:
+      return None if raw is None else int(raw)
+    except ValueError:
+      return None
+
+  def owner_dead(self, r):
+    """Positive-signal death probe for rank ``r`` (False when the
+    substrate cannot prove death — staleness timeouts then rule)."""
+    return False
+
+
+class FileLeaseStore(LeaseStore):
+  """Shared-filesystem lease store: one flat directory per phase.
+
+  CAS is ``os.link(tmp, dst)`` — atomic create-*with*-content, so a
+  reader that wins the EEXIST race never observes an empty claim file
+  (an O_EXCL-create-then-write scheme would have that window). All
+  writes ride the same bounded transient-error retry as the collective
+  substrate.
+  """
+
+  def __init__(self, root, rank, dead_probe=None):
+    self.root = root
+    self.rank = rank
+    self.manifest_root = root
+    self._dead_probe = dead_probe
+    os.makedirs(root, exist_ok=True)
+
+  def _p(self, key):
+    return os.path.join(self.root, key)
+
+  def try_claim(self, key):
+    dst = self._p(key)
+
+    def _attempt():
+      fd, tmp = tempfile.mkstemp(dir=self.root)
+      try:
+        with os.fdopen(fd, 'wb') as f:
+          f.write(str(self.rank).encode())
+        try:
+          os.link(tmp, dst)
+          return None
+        except FileExistsError:
+          return self._read_owner(dst)
+      finally:
+        os.unlink(tmp)
+
+    return _retry_io(_attempt, f'claim {key}')
+
+  def _read_owner(self, dst):
+    def _attempt():
+      with open(dst, 'rb') as f:
+        return f.read()
+    try:
+      return int(_retry_io(_attempt, 'claim owner read').decode())
+    except (OSError, ValueError, UnicodeDecodeError):
+      return -1  # owner momentarily unreadable: foreign, identity unknown
+
+  def publish(self, key, payload):
+    dst = self._p(key)
+
+    def _attempt():
+      fd, tmp = tempfile.mkstemp(dir=self.root)
+      with os.fdopen(fd, 'wb') as f:
+        f.write(payload)
+      os.rename(tmp, dst)
+
+    _retry_io(_attempt, f'publish {key}')
+
+  def read(self, key):
+    path = self._p(key)
+
+    def _attempt():
+      try:
+        with open(path, 'rb') as f:
+          return f.read()
+      except FileNotFoundError:
+        return None  # absence is an answer, not a transient error
+
+    return _retry_io(_attempt, f'read {key}')
+
+  def list(self, prefix):
+    return _retry_io(
+        lambda: sorted(
+            n for n in os.listdir(self.root) if n.startswith(prefix)),
+        f'list {prefix}')
+
+  def owner_dead(self, r):
+    return bool(self._dead_probe and self._dead_probe(r))
+
+
+class KVLeaseStore(LeaseStore):
+  """Best-effort lease store over the jax coordination-service KV.
+
+  The coordination service rejects ``InsertKeyValue`` on an existing
+  key, which is the CAS :meth:`try_claim` leans on. Should a runtime
+  silently overwrite instead, two ranks may both believe they won a
+  claim and both execute the partition — duplicated work, never wrong
+  bytes: task outputs are ``f(task, global_index)`` and shard writes are
+  atomic renames, so re-execution is idempotent by construction. No
+  cross-host pid probe exists here, so :meth:`owner_dead` always defers
+  to the heartbeat-staleness path.
+  """
+
+  def __init__(self, client, namespace, rank):
+    self._client = client
+    self._pfx = f'lddl/el/{namespace}/'
+    self.rank = rank
+
+  def try_claim(self, key):
+    try:
+      self._client.key_value_set_bytes(
+          self._pfx + key, str(self.rank).encode())
+      return None
+    except Exception:
+      raw = self.read(key)
+      try:
+        return -1 if raw is None else int(raw)
+      except ValueError:
+        return -1
+
+  def publish(self, key, payload):
+    try:
+      self._client.key_value_set_bytes(self._pfx + key, bytes(payload))
+    except Exception:
+      # Existing key (heartbeat republish / idempotent manifest rewrite):
+      # delete+set. Only this rank writes its own mutable keys, so the
+      # non-atomic pair cannot interleave with another writer.
+      self._client.key_value_delete(self._pfx + key)
+      self._client.key_value_set_bytes(self._pfx + key, bytes(payload))
+
+  def read(self, key, timeout_ms=50):
+    try:
+      return self._client.blocking_key_value_get_bytes(
+          self._pfx + key, timeout_ms)
+    except Exception:
+      return None  # missing key surfaces as a get timeout
+
+  def list(self, prefix):
+    try:
+      entries = self._client.key_value_dir_get_bytes(self._pfx)
+    except Exception:
+      return []
+    out = []
+    for key, _value in entries:
+      if isinstance(key, bytes):
+        key = key.decode()
+      if key.startswith(self._pfx):
+        key = key[len(self._pfx):]
+      if key.startswith(prefix):
+        out.append(key)
+    return sorted(out)
 
 
 def ensure_jax_distributed():
@@ -400,6 +704,21 @@ class JaxProcessBackend(CommBackend):
       return None
     from ..core.compat import distributed_client
     return distributed_client()
+
+  def lease_store(self, namespace):
+    """KV-backed lease store (any device platform — the coordination
+    service exists on every multi-process runtime), or None when no
+    distributed client is reachable (single-process: nothing to lease)."""
+    if self.world_size <= 1:
+      return None
+    try:
+      from ..core.compat import distributed_client
+      client = distributed_client()
+    except Exception:
+      return None
+    if client is None:
+      return None
+    return KVLeaseStore(client, namespace, self.rank)
 
   def _kv_allgather(self, payload, seq):
     """All ranks' bytes via the KV store: set own key, blocking-get all

@@ -1,0 +1,108 @@
+"""Deterministic, env-gated fault injection for the robustness tests.
+
+The lease/claim machinery (pipeline/executor.py), the pool respawn path
+(pipeline/pool.py), and the comm retry path (comm/backend.py) all exist
+to survive faults that are miserable to reproduce organically: a rank
+SIGKILLed mid-partition, an OOM-killed pool worker, a transient EIO out
+of a flaky NFS rendezvous mount. This module turns each of those into a
+one-line env spec so tier-1 tests exercise the exact recovery branch on
+every run.
+
+Spec grammar (env ``LDDL_FAULTS``; ``;``-separated, each fires
+independently)::
+
+  <action>:<site>[:k=v,...]
+
+  actions:  kill    SIGKILL the current process (no cleanup, no atexit)
+            raise   raise OSError('injected fault ...')
+            delay   sleep ``sec`` seconds (default 0.1)
+  filters:  rank=R  only when the caller passes rank=R
+            gi=N    only when the caller passes gi=N
+            nth=K   only on the K-th matching hit in this process (1-based)
+            once    at most once per ``LDDL_FAULTS_DIR`` marker — survives
+                    process restarts, so a killed-then-restarted run does
+                    not re-trip the same fault (the resume tests need
+                    exactly this)
+  extras:   sec=S   delay duration
+
+Instrumented sites: ``elastic.task`` (executor lease-claimed task entry),
+``pool.task`` (pool worker task entry), ``comm.write`` (FileBackend
+atomic write). ``inject()`` is a no-op (one env read) when
+``LDDL_FAULTS`` is unset, so production paths pay nothing measurable.
+"""
+
+import os
+import re
+import signal
+import time
+
+# Per-process hit counters keyed by full spec text: ``nth`` is a count of
+# *matching* invocations in this process, deterministic because every
+# instrumented site sits on a deterministic execution path.
+_counts = {}
+
+
+def reset():
+  """Forget per-process hit counts (test isolation)."""
+  _counts.clear()
+
+
+def _once_marker(spec):
+  name = 'fired.' + re.sub(r'[^A-Za-z0-9]+', '_', spec)
+  return os.path.join(os.environ.get('LDDL_FAULTS_DIR', ''), name)
+
+
+def _fire(action, site, opts):
+  if action == 'kill':
+    os.kill(os.getpid(), signal.SIGKILL)
+  if action == 'raise':
+    raise OSError(f'injected fault at {site}')
+  if action == 'delay':
+    time.sleep(float(opts.get('sec', '0.1')))
+    return
+  raise ValueError(f'unknown fault action {action!r}')
+
+
+def _maybe_fire(spec, site, ctx):
+  fields = spec.split(':')
+  if len(fields) < 2 or fields[1] != site:
+    return
+  action = fields[0]
+  opts = {}
+  for kv in (fields[2].split(',') if len(fields) > 2 else ()):
+    k, _, v = kv.partition('=')
+    opts[k] = v
+  for key in ('rank', 'gi'):
+    if key in opts and str(ctx.get(key)) != opts[key]:
+      return
+  _counts[spec] = _counts.get(spec, 0) + 1
+  if 'nth' in opts and _counts[spec] != int(opts['nth']):
+    return
+  if 'once' in opts:
+    marker = _once_marker(spec)
+    if not os.environ.get('LDDL_FAULTS_DIR'):
+      raise ValueError("'once' fault filter needs LDDL_FAULTS_DIR")
+    try:
+      # O_EXCL create is the atomic claim: exactly one process across
+      # the fault's whole lifetime (restarts included) wins the fire.
+      fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+      os.close(fd)
+    except FileExistsError:
+      return
+  _fire(action, site, opts)
+
+
+def inject(site, **ctx):
+  """Fire any configured fault matching ``site`` + ``ctx`` filters.
+
+  Call at the top of a recoverable operation, passing whatever identity
+  the filters should see (``gi=``, ``rank=``). No-op when ``LDDL_FAULTS``
+  is unset.
+  """
+  specs = os.environ.get('LDDL_FAULTS', '')
+  if not specs:
+    return
+  for spec in specs.split(';'):
+    spec = spec.strip()
+    if spec:
+      _maybe_fire(spec, site, ctx)

@@ -20,20 +20,255 @@ with ``JaxProcessBackend`` gives multi-host scaling without MPI; results
 re-gathered with the backend's collectives.
 """
 
+import functools
 import json
 import multiprocessing as _mp
 import os
+import pickle
 import sys
 import tempfile
+import threading
 import time
 import weakref
 
-from ..comm import NullBackend
+from ..comm import NullBackend, comm_heartbeat_interval
+from ..core import faults
 from ..telemetry import get_telemetry
 from ..telemetry.server import maybe_start_monitor
 from ..telemetry.trace import get_tracer
-from .pool import (AsyncShardWriter, PoolBroken, WorkerPool,
-                   _default_mp_context, install_writer, write_back_enabled)
+from .parquet_io import (MANIFEST_MISSING, manifest_key,
+                         publish_result_manifest, read_result_manifest)
+from .pool import (AsyncShardWriter, PoolBroken, TaskFailed, WorkerPool,
+                   WriteBackError, _default_mp_context, install_writer,
+                   write_back_enabled)
+
+#: Idle wait between claim passes while peers hold every pending lease.
+_ELASTIC_POLL = 0.05
+
+
+def elastic_enabled(comm):
+  """Whether ``map()`` runs the lease-claimed elastic path over ``comm``.
+
+  Env ``LDDL_ELASTIC``: ``0/false/off`` forces the static stride
+  (escape hatch); ``1/on`` uses leases wherever the backend offers a
+  store (including the best-effort jax coordination-service KV store);
+  unset/auto enables it only where the claim substrate is first-class
+  (``elastic_default``, today the FileBackend — which also covers
+  world-size-1 runs, where the lease manifests are what makes a killed
+  preprocess resumable)."""
+  v = os.environ.get('LDDL_ELASTIC', '').strip().lower()
+  if v in ('0', 'false', 'off', 'no'):
+    return False
+  if v in ('1', 'true', 'on', 'yes'):
+    return True
+  return getattr(comm, 'elastic_default', False)
+
+
+def lease_timeout():
+  """Seconds of heartbeat silence before survivors revoke a lease (env
+  ``LDDL_LEASE_TIMEOUT``). The pid-beacon death probe usually fires far
+  earlier on same-host worlds; this is the cross-host backstop."""
+  try:
+    return max(0.2, float(os.environ.get('LDDL_LEASE_TIMEOUT', '60')))
+  except ValueError:
+    return 60.0
+
+
+def _elastic_run(fn, publisher, rank, task, global_index):
+  """Elastic task wrapper (module-level, picklable for pool dispatch):
+  run the task, then publish its completion manifest through the
+  write-back-ordered path — so the manifest can only land after the
+  task's shard writes are durable. The fault site is what the
+  robustness tests drive kills/delays/IO-errors through."""
+  faults.inject('elastic.task', gi=global_index, rank=rank)
+  result = fn(task, global_index)
+  if publisher is not None:
+    publisher(global_index, result)
+  return result
+
+
+class _ElasticTaskError:
+  """Pickled into a completion manifest when a task fails: the phase
+  still *completes* on every rank (no partition is left permanently
+  pending, which would deadlock the manifest wait), and every rank
+  raises the same error at gather time."""
+
+  def __init__(self, err):
+    self.err = err
+
+
+def _publish_error_manifest(store, gi, err):
+  """Best-effort: record a task failure as the partition's manifest.
+  ``err`` is an exception or a worker traceback string. Failure to
+  publish is survivable — the local raise stops this rank's heartbeat,
+  so peers still recover via the staleness path."""
+  text = err if isinstance(err, str) else f'{type(err).__name__}: {err}'
+  try:
+    store.publish(manifest_key(gi), pickle.dumps(_ElasticTaskError(text)))
+  except OSError:
+    return
+
+
+class _HeartbeatPump:
+  """Background lease heartbeat for one elastic phase.
+
+  Republishes a monotonically increasing counter every interval while
+  the rank executes — the main thread may block for minutes inside pool
+  waits, so liveness cannot ride the claim traffic itself. The value is
+  a counter, not a timestamp: observers measure staleness of an
+  *unchanging* counter on their own clock, so cross-host clock skew can
+  never manufacture a revocation.
+  """
+
+  def __init__(self, store, interval):
+    self._store = store
+    self._interval = interval
+    self._stop = threading.Event()
+    self._beats = 0
+    # First beat lands before any claim this rank makes: a peer that
+    # sees our claim can always already see a heartbeat to age.
+    self._store.heartbeat(0)
+    self._thread = threading.Thread(
+        target=self._run, name='lddl-lease-hb', daemon=True)
+    self._thread.start()
+
+  def _run(self):
+    while not self._stop.wait(self._interval):
+      self._beats += 1
+      try:
+        self._store.heartbeat(self._beats)
+      except OSError:
+        continue  # transient substrate flap: the next beat retries
+
+  def stop(self):
+    self._stop.set()
+    self._thread.join(timeout=5.0)
+
+
+class _LeaseClaimer:
+  """Rank-local view of one elastic phase's lease namespace.
+
+  Which rank executes which partition is racy by design — claims go to
+  whoever wins the CAS first, so a fast rank absorbs a slow or dead
+  rank's share. What each partition *produces* is ``f(task,
+  global_index)`` with atomic-rename writes, so the shard bytes are
+  identical to the fault-free static-stride run no matter how claims
+  land, how often a lease is revoked, or how many times a partition is
+  re-executed.
+
+  Revocation: a pending foreign claim is revoked when its owner is
+  positively dead (pid beacon) or its heartbeat counter has not moved
+  for the lease timeout. The decision inputs are shared state every
+  survivor reads identically, so all survivors reach the same verdict;
+  the ``revoke`` CAS then picks exactly one winner fleet-wide, and the
+  generation bump makes ``claim.<gi>.g<gen+1>`` claimable again.
+  """
+
+  def __init__(self, store, order, timeout=None, telemetry=None):
+    self._store = store
+    self._order = list(order)
+    self._timeout = lease_timeout() if timeout is None else timeout
+    self._done = set()
+    self._mine = set()  # claims this rank won (executed this incarnation)
+    self._gen = {}  # gi -> live claim generation
+    self._foreign = {}  # (gi, gen) -> owning rank (immutable once read)
+    self._hb_seen = {}  # owner -> (counter value, monotonic when it changed)
+    tele = telemetry if telemetry is not None else get_telemetry()
+    self._claims = tele.counter('pipeline.elastic.claims')
+    self._reexecutions = tele.counter('pipeline.elastic.reexecutions')
+    self._revokes = tele.counter('pipeline.elastic.revokes')
+
+  @property
+  def done_count(self):
+    return len(self._done)
+
+  def all_done(self):
+    return len(self._done) == len(self._order)
+
+  def refresh(self):
+    """Sync the completion set from published manifests. Returns how
+    many newly completed partitions were observed."""
+    before = len(self._done)
+    for key in self._store.list('done.'):
+      suffix = key[len('done.'):]
+      if suffix.isdigit():
+        self._done.add(int(suffix))
+    return len(self._done) - before
+
+  def next_claim(self):
+    """Win and return the next partition this rank should execute (in
+    LPT preference order), or None when every pending partition is
+    done, ours, or held by a peer."""
+    for gi in self._order:
+      if gi in self._done or gi in self._mine:
+        continue
+      gen = self._gen.get(gi, 0)
+      if (gi, gen) in self._foreign:
+        continue
+      owner = self._store.try_claim(f'claim.{gi}.g{gen}')
+      if owner is None or owner == self._store.rank:
+        # None: the CAS was won just now. Our own rank: the claim is
+        # left over from a previous incarnation of this run (restart
+        # before the manifest landed) — the lease is still ours and
+        # re-execution is idempotent, so run it rather than waiting for
+        # peers to age it out.
+        self._mine.add(gi)
+        self._claims.add(1)
+        if gen > 0:
+          self._reexecutions.add(1)
+        return gi
+      if owner >= 0:
+        # Cache: the owner of (gi, gen) can never change, so one CAS
+        # attempt per generation per rank is all the traffic claims
+        # cost. (-1 = owner momentarily unreadable: retry next pass.)
+        self._foreign[(gi, gen)] = owner
+    return None
+
+  def pending_unclaimed(self):
+    """Whether a claim pass could currently win anything."""
+    return any(
+        gi not in self._done and gi not in self._mine and
+        (gi, self._gen.get(gi, 0)) not in self._foreign
+        for gi in self._order)
+
+  def observe(self):
+    """Death/staleness sweep over foreign-held pending partitions.
+
+    Revokes stale leases (CAS: one winner fleet-wide counts the revoke)
+    and bumps the local generation so the next claim pass re-executes.
+    Returns True when any lease was newly revoked (work opened up)."""
+    progressed = False
+    for gi in self._order:
+      if gi in self._done or gi in self._mine:
+        continue
+      gen = self._gen.get(gi, 0)
+      owner = self._foreign.get((gi, gen))
+      if owner is None or not self._owner_stale(owner):
+        continue
+      if self._store.try_claim(f'revoke.{gi}.g{gen}') is None:
+        self._revokes.add(1)
+      self._gen[gi] = gen + 1
+      progressed = True
+    return progressed
+
+  def _owner_stale(self, owner):
+    if self._store.owner_dead(owner):
+      return True  # positive death signal: no need to wait out the lease
+    hb = self._store.read_heartbeat(owner)
+    now = time.monotonic()
+    prev = self._hb_seen.get(owner)
+    if prev is None or prev[0] != hb:
+      self._hb_seen[owner] = (hb, now)
+      return False
+    # lddl: noqa[LDA003] lease staleness: survivors revoke only on a
+    # heartbeat counter silent past the lease timeout (or the positive
+    # death probe above). Racing observers converge on the same verdict
+    # via the revoke CAS, and re-execution is idempotent — outputs are
+    # f(task, global_index) behind atomic renames — so clock skew can
+    # cost duplicated work, never divergent bytes.
+    if now - prev[1] > self._timeout:
+      return True
+    return False
 
 
 def _run_task(fn, global_index, task):
@@ -146,6 +381,7 @@ class Executor:
     self._pool = None
     self._finalizer = None
     self._warmups = {}  # key -> zero-arg picklable callable
+    self._label_counts = {}  # map label -> phases run (elastic namespaces)
     spec = os.environ.get('LDDL_PROGRESS', '')
     # '0'/'false'/'off' must disable, not become a directory named '0'.
     self._progress = (ProgressReporter(spec, self._comm.rank)
@@ -222,7 +458,33 @@ class Executor:
         'stealing': self._num_local_workers > 1,
         'lpt': self._num_local_workers > 1,
         'write_back': write_back_enabled(),
+        'elastic': elastic_enabled(self._comm),
     }
+
+  # -- elastic phase namespaces ---------------------------------------------
+
+  def _elastic_store(self, label, peek=False):
+    """Lease store for the next map phase labeled ``label``, or None for
+    the static-stride path. Namespaces are ``<label>.<n>`` with a
+    per-label counter: ranks call ``map`` in lockstep and a restarted
+    run replays the same call sequence, so namespaces line up across
+    ranks and across restarts — which is exactly what makes completion
+    manifests resumable."""
+    if not elastic_enabled(self._comm):
+      return None
+    n = self._label_counts.get(label, 0)
+    if not peek:
+      self._label_counts[label] = n + 1
+    return self._comm.lease_store(f'{label}.{n}')
+
+  def resume_pending(self, label):
+    """Whether the comm substrate already holds completion manifests for
+    the next map phase labeled ``label`` — i.e. this run is a restart
+    that will skip published work. Callers use it to preserve partial
+    outputs a resume still needs (e.g. ``run_shuffled``'s spill
+    pre-clean)."""
+    store = self._elastic_store(label, peek=True)
+    return bool(store is not None and store.list('done.'))
 
   # -- map ------------------------------------------------------------------
 
@@ -257,8 +519,19 @@ class Executor:
     map_span = tele.span(f'pipeline.{label}.map_seconds')
     t_map = time.monotonic()
     map_span.__enter__()
-    pooled = self._num_local_workers > 1 and len(my_indices) > 1
-    if not pooled:
+    store = self._elastic_store(label) if tasks else None
+    pooled = self._num_local_workers > 1 and (
+        len(tasks) > 1 if store is not None else len(my_indices) > 1)
+    if store is not None:
+      # Elastic path: task ownership is negotiated through CAS'd leases
+      # instead of the stride, so live ranks absorb dead/slow ranks'
+      # shares and restarts skip manifested partitions. No collectives —
+      # a dead rank can never hang the phase.
+      ordered = self._map_elastic(fn, tasks, store, pooled, label,
+                                  task_name, cost_key, task_hist,
+                                  tasks_done, tracer, tele, local_results)
+      total = len(tasks)
+    elif not pooled:
       self._map_serial(fn, tasks, my_indices, label, task_name,
                        task_hist, tasks_done, tracer, tele, local_results)
     else:
@@ -271,7 +544,10 @@ class Executor:
     if tracer.enabled:
       tracer.complete(f'pipeline.{label}.map', t_map,
                       time.monotonic() - t_map,
-                      args={'tasks': len(my_indices)})
+                      args={'tasks': total})
+    if store is not None:
+      local_results.sort(key=lambda r: r[0])
+      return ordered if gather else local_results
     if not gather:
       self._comm.barrier()
       return local_results
@@ -378,3 +654,177 @@ class Executor:
     # The shared queue hands results back in completion order; the
     # contract is task order.
     local_results.sort(key=lambda r: r[0])
+
+  # -- elastic map (lease-claimed partitions) -------------------------------
+
+  def _map_elastic(self, fn, tasks, store, pooled, label, task_name,
+                   cost_key, task_hist, tasks_done, tracer, tele,
+                   local_results):
+    """Lease-claimed variant of map: the full task list is the shared
+    work pool; ranks claim partitions through CAS'd leases in LPT order,
+    publish completion manifests next to the shards, and revoke+re-run
+    leases whose owner dies or goes silent. Phase completion is "every
+    partition has a manifest" — no collectives, so a dead rank cannot
+    hang survivors. Returns the manifest-ordered result list."""
+    total = len(tasks)
+
+    def cost(i):
+      return cost_key(tasks[i], i) if cost_key is not None else i
+
+    order = sorted(range(total), key=lambda i: (-cost(i), i))
+    claimer = _LeaseClaimer(store, order, telemetry=tele)
+    skipped = claimer.refresh()
+    if skipped:
+      # Restart-resume: these partitions were published by a previous
+      # incarnation of this run; their shards are already on disk.
+      tele.counter('pipeline.elastic.resume_skipped').add(skipped)
+    # FileLeaseStore manifests live on the shared filesystem: workers
+    # publish them through their own write-back queue (ordered after the
+    # task's shard writes). KV stores have no worker-reachable substrate,
+    # so the parent publishes after each pass instead.
+    publisher = (functools.partial(publish_result_manifest,
+                                   store.manifest_root)
+                 if store.manifest_root else None)
+    wrapped = functools.partial(_elastic_run, fn, publisher,
+                                self._comm.rank)
+    progress_gauge = tele.gauge(f'pipeline.{label}.progress_frac')
+    pump = _HeartbeatPump(store, comm_heartbeat_interval())
+    try:
+      while not claimer.all_done():
+        if pooled:
+          executed = self._elastic_pass_pooled(
+              wrapped, tasks, claimer, store, publisher is None, label,
+              task_name, task_hist, tasks_done, tracer, tele,
+              local_results)
+        else:
+          executed = self._elastic_pass_serial(
+              wrapped, tasks, claimer, store, publisher is None,
+              task_name, task_hist, tasks_done, tracer, tele,
+              local_results)
+        claimer.refresh()
+        progress_gauge.set(claimer.done_count / total)
+        if self._progress:
+          self._progress.update(label, claimer.done_count, total)
+        if claimer.all_done():
+          break
+        revoked = claimer.observe()
+        if not executed and not revoked and not claimer.pending_unclaimed():
+          # Peers hold every pending lease and none is stale: wait for
+          # their manifests (or for a lease to age into revocation).
+          time.sleep(_ELASTIC_POLL)
+    finally:
+      pump.stop()
+    return self._collect_manifests(store, total, label)
+
+  def _elastic_pass_serial(self, wrapped, tasks, claimer, store,
+                           parent_publish, task_name, task_hist,
+                           tasks_done, tracer, tele, local_results):
+    """One serial claim-execute pass; returns tasks executed."""
+    executed = []
+    writer = AsyncShardWriter() if write_back_enabled() else None
+    previous = install_writer(writer)
+    try:
+      while True:
+        gi = claimer.next_claim()
+        if gi is None:
+          break
+        t0 = time.monotonic()
+        try:
+          res = wrapped(tasks[gi], gi)
+        except Exception as e:
+          # Publish the failure as the partition's manifest so peers
+          # complete the phase and raise the same error instead of
+          # waiting forever on a partition nobody can finish.
+          _publish_error_manifest(store, gi, e)
+          raise
+        dt = time.monotonic() - t0
+        task_hist.observe(dt)
+        tasks_done.add(1)
+        tracer.complete(task_name, t0, dt, tid=os.getpid())
+        local_results.append((gi, res))
+        executed.append((gi, res))
+      if writer is not None:
+        writer.flush()
+    except BaseException:
+      if writer is not None:
+        writer.close(raise_errors=False)
+        writer = None
+      raise
+    finally:
+      install_writer(previous)
+      if writer is not None:
+        backlog = writer.take_backlog_hwm()
+        writer.close()
+        tele.gauge('pipeline.pool.writer_backlog').set(backlog)
+    if parent_publish:
+      for gi, res in executed:
+        store.publish(manifest_key(gi), pickle.dumps(res))
+    return len(executed)
+
+  def _elastic_pass_pooled(self, wrapped, tasks, claimer, store,
+                           parent_publish, label, task_name, task_hist,
+                           tasks_done, tracer, tele, local_results):
+    """One pooled claim-execute pass over :meth:`WorkerPool.run_stream`;
+    claims are won lazily as workers free up, so claim order adapts to
+    this rank's actual throughput. Returns tasks executed."""
+    pool = self._get_pool()
+    executed = []
+    idle_hist = tele.histogram(f'pipeline.{label}.worker_idle_seconds')
+
+    def puller():
+      gi = claimer.next_claim()
+      if gi is None:
+        return None
+      return (gi, tasks[gi], 0)
+
+    def on_result(msg):
+      _, gi, res, terr, t0, dt, pid, wid, pos, wait = msg
+      if terr is None:
+        task_hist.observe(dt)
+        tasks_done.add(1)
+        idle_hist.observe(wait)
+        tracer.complete(task_name, t0, dt, tid=pid)
+        local_results.append((gi, res))
+        executed.append((gi, res))
+
+    try:
+      records = pool.run_stream(wrapped, puller, on_result=on_result)
+      hwms, flush_errs = pool.flush_round()
+    except PoolBroken:
+      self._drop_pool(force=True)
+      raise
+    tele.gauge('pipeline.pool.writer_backlog').set(max(hwms) if hwms else 0)
+    failed = sorted((m for m in records if m[3] is not None),
+                    key=lambda m: m[1])
+    if failed:
+      gi, err = failed[0][1], failed[0][3]
+      _publish_error_manifest(store, gi, err)
+      raise TaskFailed(
+          f'task (global index {gi}) failed in pool worker:\n{err}')
+    if flush_errs:
+      # A lost deferred write means this rank's manifests for those
+      # shards were withheld (the writer refuses to vouch for them);
+      # failing here stops our heartbeat, so survivors revoke the
+      # affected leases and re-execute.
+      raise WriteBackError(
+          'deferred shard write(s) failed:\n' + '\n'.join(flush_errs))
+    if parent_publish:
+      for gi, res in executed:
+        store.publish(manifest_key(gi), pickle.dumps(res))
+    return len(executed)
+
+  def _collect_manifests(self, store, total, label):
+    ordered = []
+    for gi in range(total):
+      res = read_result_manifest(store, gi)
+      if res is MANIFEST_MISSING:
+        raise RuntimeError(
+            f'map({label!r}) completion manifest for task {gi} vanished '
+            'after the phase completed — the lease substrate was '
+            'modified externally')
+      if isinstance(res, _ElasticTaskError):
+        raise TaskFailed(
+            f'task (global index {gi}) failed on another rank (reported '
+            f'via its completion manifest):\n{res.err}')
+      ordered.append(res)
+    return ordered

@@ -15,6 +15,8 @@ The bin split here is a vectorized numpy grouping over the partition's
 """
 
 import os
+import pickle
+import tempfile
 
 import numpy as np
 import pyarrow as pa
@@ -63,7 +65,12 @@ def write_shard_file(table, path, output_format='parquet',
   if compression == 'default':
     compression = _default_compression()
   out_dir = os.path.dirname(path) or '.'
-  tmp = os.path.join(out_dir, f'.{os.path.basename(path)}.tmp')
+  # pid-unique tmp name: under the elastic executor a revoked-but-alive
+  # owner can briefly race the re-executing survivor on the same shard;
+  # both write identical bytes, but a *shared* tmp path would let one
+  # truncate the other mid-write. Distinct tmps + atomic rename keep the
+  # final file well-formed whichever rename lands last.
+  tmp = os.path.join(out_dir, f'.{os.path.basename(path)}.{os.getpid()}.tmp')
   try:
     if output_format == 'parquet':
       # Dictionary encoding buys nothing on long, mostly-unique token
@@ -184,3 +191,66 @@ def write_table_partition(
 def read_samples(path, columns=None):
   """Read a Parquet shard back into a list of row dicts."""
   return pq.read_table(path, columns=columns).to_pylist()
+
+
+# ---------------------------------------------------------------------------
+# completion manifests (elastic executor / resumable preprocessing)
+
+
+def manifest_key(global_index):
+  """Completion-manifest key for one task of an elastic map phase."""
+  return f'done.{int(global_index)}'
+
+
+def write_manifest_file(manifest_root, global_index, payload):
+  """Atomically publish one completion manifest (tmp + rename, same
+  durability discipline as :func:`write_shard_file`). ``payload`` is the
+  pickled task result; the manifest's *existence* is the completion bit
+  the lease protocol and restart-resume key on, so it must only ever
+  appear whole."""
+  fd, tmp = tempfile.mkstemp(dir=manifest_root)
+  with os.fdopen(fd, 'wb') as f:
+    f.write(payload)
+  os.rename(tmp, os.path.join(manifest_root, manifest_key(global_index)))
+
+
+def publish_result_manifest(manifest_root, global_index, result):
+  """Publish ``done.<gi>`` for a finished task, ordered after its shard
+  writes.
+
+  Runs inside the worker that executed the task. With an ambient
+  :class:`~.pool.AsyncShardWriter` the manifest is *submitted* to the
+  same FIFO queue the task's shard writes went through, so it can only
+  land after they are durable; the job withholds publication when an
+  earlier write on that queue already failed — a manifest must never
+  vouch for shards that were not written. Without a writer the task's
+  writes already completed inline, so the manifest is written directly.
+  """
+  payload = pickle.dumps(result)
+  from .pool import current_writer
+  writer = current_writer()
+  if writer is not None:
+    writer.submit(_manifest_write_job, manifest_root, global_index, payload)
+  else:
+    write_manifest_file(manifest_root, global_index, payload)
+
+
+def _manifest_write_job(manifest_root, global_index, payload):
+  # Executes on the writer thread, after every earlier job of this task.
+  from .pool import current_writer
+  writer = current_writer()
+  if writer is not None and writer.failed:
+    return  # an earlier shard write failed: the phase will fail and retry
+  write_manifest_file(manifest_root, global_index, payload)
+
+
+def read_result_manifest(store, global_index):
+  """Unpickled result from a :class:`~..comm.backend.LeaseStore`
+  manifest, or the sentinel ``MANIFEST_MISSING`` when absent (results
+  may legitimately be None)."""
+  raw = store.read(manifest_key(global_index))
+  return MANIFEST_MISSING if raw is None else pickle.loads(raw)
+
+
+#: Sentinel distinguishing "no manifest yet" from a published None result.
+MANIFEST_MISSING = object()

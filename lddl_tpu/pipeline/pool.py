@@ -31,7 +31,9 @@ queue order, and write-back timing.
 import multiprocessing as _mp
 import os
 import queue as _queue
+import shutil
 import sys
+import tempfile
 import threading
 import time
 import traceback
@@ -107,6 +109,13 @@ class AsyncShardWriter:
       finally:
         self._q.task_done()
 
+  @property
+  def failed(self):
+    """Whether any submitted job has failed (first error is retained).
+    The manifest job checks this before publishing: a completion
+    manifest must never vouch for a shard write that did not land."""
+    return self._err is not None
+
   def _raise_pending(self):
     if self._err is not None:
       raise WriteBackError(
@@ -163,7 +172,7 @@ def _format_remote_error(exc):
       traceback.format_exception(type(exc), exc, exc.__traceback__))
 
 
-def _worker_main(worker_id, task_q, result_q, barrier, warmups):
+def _worker_main(worker_id, task_q, result_q, barrier, warmups, scratch):
   """Pool worker loop: warm up once, then pull from the shared queue.
 
   Message protocol (task_q -> worker): ``('task', fn, gi, task, pos)``,
@@ -172,7 +181,14 @@ def _worker_main(worker_id, task_q, result_q, barrier, warmups):
   wid, pos, wait)``, ``('flush_ack', wid, backlog_hwm, err)``,
   ``('call_ack', wid, err)``. ``flush``/``call`` end on the shared
   barrier so each of the N tokens is consumed by a distinct worker.
+
+  In-flight attribution rides a marker *file* (``scratch/inflight.<wid>``
+  holding the last-started gi), written before each task executes — a
+  queue message would race SIGKILL (the feeder thread may never flush
+  it), but a rename survives any death, so the parent's respawn path can
+  always name the task an abruptly dead worker was holding.
   """
+  from ..core import faults
   err = None
   try:
     for fn in warmups:
@@ -189,9 +205,14 @@ def _worker_main(worker_id, task_q, result_q, barrier, warmups):
     kind = msg[0]
     if kind == 'task':
       _, fn, gi, task, pos = msg
+      marker = os.path.join(scratch, f'inflight.{worker_id}')
+      with open(marker + '.tmp', 'w') as f:
+        f.write(str(gi))
+      os.replace(marker + '.tmp', marker)
       res, terr = None, None
       t0 = time.monotonic()
       try:
+        faults.inject('pool.task', gi=gi)
         res = fn(task, gi)
       except BaseException as e:  # noqa: BLE001
         terr = _format_remote_error(e)
@@ -251,17 +272,14 @@ class WorkerPool:
     self._task_q = ctx.Queue()
     self._result_q = ctx.Queue()
     self._barrier = ctx.Barrier(num_workers + 1)
+    self._scratch = tempfile.mkdtemp(prefix='lddl-pool-')
     self._closed = False
+    # Full warmup history (ctor hooks + later broadcasts): a respawned
+    # worker must replay all of it to match its peers' warm state.
+    self._warmups = list(warmups)
     self._procs = []
     for w in range(num_workers):
-      p = ctx.Process(
-          target=_worker_main,
-          args=(w, self._task_q, self._result_q, self._barrier,
-                tuple(warmups)),
-          name=f'lddl-pool-{w}',
-          daemon=True)
-      p.start()
-      self._procs.append(p)
+      self._procs.append(self._spawn_worker(w))
     self.worker_pids = [None] * num_workers
     try:
       for _ in range(num_workers):
@@ -276,19 +294,46 @@ class WorkerPool:
       self.shutdown(force=True)
       raise
 
-  def _next_result(self):
+  def _spawn_worker(self, wid):
+    p = self._ctx.Process(
+        target=_worker_main,
+        args=(wid, self._task_q, self._result_q, self._barrier,
+              tuple(self._warmups), self._scratch),
+        name=f'lddl-pool-{wid}',
+        daemon=True)
+    p.start()
+    return p
+
+  def _respawn(self, wid):
+    """Replace dead worker ``wid`` with a fresh process (same queues,
+    same barrier slot, full warmup replay). Its 'ready' message arrives
+    asynchronously on the result queue."""
+    self._procs[wid].join(timeout=5.0)
+    self.worker_pids[wid] = None
+    self._procs[wid] = self._spawn_worker(wid)
+    get_telemetry().counter('pipeline.pool.respawns').add(1)
+
+  def _next_result(self, allow_dead=False):
     """Next message off the result queue, raising if a worker died
     (instead of hanging forever on a queue a dead worker will never
-    feed)."""
+    feed). With ``allow_dead`` a death is returned as
+    ``('worker_died', [wid, ...])`` for the caller's recovery path
+    instead of raising. The queue is provably drained at that point
+    (1s of Empty), so any result the dead worker managed to flush has
+    already been consumed."""
     while True:
       try:
         return self._result_q.get(timeout=1.0)
       except _queue.Empty:
-        dead = [(p.name, p.exitcode) for p in self._procs
-                if not p.is_alive()]
-        if dead:
-          raise PoolBroken(
-              f'pool worker(s) died: {dead}; the phase cannot complete')
+        dead = [w for w, p in enumerate(self._procs) if not p.is_alive()]
+        if not dead:
+          continue
+        if allow_dead:
+          return ('worker_died', dead)
+        named = [(self._procs[w].name, self._procs[w].exitcode)
+                 for w in dead]
+        raise PoolBroken(
+            f'pool worker(s) died: {named}; the phase cannot complete')
 
   def _barrier_wait(self):
     try:
@@ -296,10 +341,149 @@ class WorkerPool:
     except threading.BrokenBarrierError:
       raise PoolBroken('pool workers failed to reach the phase barrier')
 
+  def run_stream(self, fn, puller, on_result=None):
+    """Feed the pool incrementally from ``puller`` until it runs dry.
+
+    ``puller()`` returns the next ``(gi, task, cost)`` to run or None
+    when nothing more is currently available (the elastic executor's
+    lease claimer hands out work this way — a partition is only pulled
+    once its claim is won, so claim order adapts to execution speed).
+    At most ``num_workers + 2`` tasks are in flight; each completion
+    pulls the next. Returns the raw result records (completion order).
+
+    Single-worker-death recovery: a worker that dies *while executing a
+    task* (its in-flight marker file names the task) is respawned and
+    the task re-enqueued, once — the transient-OOM shape. A task that
+    kills its worker twice, more than ``num_workers`` respawns in one
+    stream, simultaneous multi-worker death, or a death with no task
+    attributable all raise :class:`PoolBroken`: those are systemic, not
+    transient.
+    """
+    if self._closed:
+      raise PoolBroken('pool already shut down')
+    max_inflight = self.num_workers + 2
+    enq = {}  # gi -> (task, original queue position)
+    awaiting = set()  # gis whose first result has not arrived
+    retried = set()  # gis re-enqueued after killing their worker
+    records = []
+    respawns = 0
+    pos = 0
+    exhausted = False
+
+    def _fill():
+      nonlocal pos, exhausted
+      while not exhausted and len(awaiting) < max_inflight:
+        item = puller()
+        if item is None:
+          exhausted = True
+          return
+        gi, task, _cost = item
+        enq[gi] = (task, pos)
+        awaiting.add(gi)
+        self._task_q.put(('task', fn, gi, task, pos))
+        pos += 1
+
+    _fill()
+    while awaiting:
+      msg = self._next_result(allow_dead=True)
+      kind = msg[0]
+      if kind == 'worker_died':
+        respawns += 1
+        if respawns > max(1, self.num_workers):
+          raise PoolBroken(
+              f'{respawns} worker deaths in one phase; respawn budget '
+              'exhausted — failing instead of masking a systemic crash')
+        self._recover_dead_worker(msg[1], fn, enq, awaiting, retried)
+        continue
+      if kind == 'ready':
+        # A respawned worker finished its warmup replay.
+        if msg[3] is not None:
+          raise PoolBroken(
+              f'respawned worker {msg[1]} warmup failed:\n{msg[3]}')
+        self.worker_pids[msg[1]] = msg[2]
+        continue
+      gi = msg[1]
+      if gi not in awaiting:
+        continue  # duplicate: worker died after its result, retry also ran
+      awaiting.discard(gi)
+      records.append(msg)
+      if on_result is not None:
+        on_result(msg)
+      _fill()
+    return records
+
+  def _read_inflight(self, wid):
+    """The gi named by dead worker ``wid``'s in-flight marker, or None.
+    Consumes the marker so a stale value can never attribute a later
+    death of the respawned worker."""
+    marker = os.path.join(self._scratch, f'inflight.{wid}')
+    try:
+      with open(marker) as f:
+        gi = int(f.read())
+      os.unlink(marker)
+      return gi
+    except (OSError, ValueError):
+      return None
+
+  def _recover_dead_worker(self, dead, fn, enq, awaiting, retried):
+    if len(dead) > 1:
+      named = [(self._procs[w].name, self._procs[w].exitcode) for w in dead]
+      raise PoolBroken(
+          f'pool workers died together: {named}; not a single-worker '
+          'transient — the phase cannot be trusted')
+    wid = dead[0]
+    gi = self._read_inflight(wid)
+    if gi is None:
+      # No task ever started on this worker (death during warmup replay
+      # or while idle before its first pull): nothing can be safely
+      # retried because nothing is attributable.
+      named = (self._procs[wid].name, self._procs[wid].exitcode)
+      raise PoolBroken(
+          f'pool worker died outside any attributed task: {named}; '
+          'the phase cannot complete safely')
+    if gi not in awaiting:
+      gi = None  # its result landed before death: nothing to retry
+    if gi is not None and gi in retried:
+      raise PoolBroken(
+          f'task (global index {gi}) killed its worker twice; '
+          'not a transient — escalating')
+    self._respawn(wid)
+    if gi is None:
+      return
+    retried.add(gi)
+    task, original_pos = enq[gi]
+    self._task_q.put(('task', fn, gi, task, original_pos))
+
+  def flush_round(self):
+    """Drain every worker's write-back queue and collect per-worker
+    backlog high-water marks: exactly num_workers flush tokens, each
+    consumed by a distinct worker (a worker that took one parks on the
+    barrier and cannot take another), so every queue is provably drained
+    before a phase's results are treated as durable. Returns
+    ``(hwms, flush_errs)``."""
+    for _ in range(self.num_workers):
+      self._task_q.put(('flush',))
+    hwms, flush_errs = [], []
+    while len(hwms) < self.num_workers:
+      msg = self._next_result()
+      if msg[0] == 'ready':
+        # A worker respawned at the tail of a stream may deliver its
+        # 'ready' here; it still consumes its flush token afterwards.
+        if msg[3] is not None:
+          raise PoolBroken(
+              f'respawned worker {msg[1]} warmup failed:\n{msg[3]}')
+        self.worker_pids[msg[1]] = msg[2]
+        continue
+      hwms.append(msg[2])
+      if msg[3] is not None:
+        flush_errs.append(msg[3])
+    self._barrier_wait()
+    return hwms, flush_errs
+
   def run_phase(self, fn, items, on_result=None):
     """Run ``fn(task, global_index)`` for every ``(gi, task, cost)``.
 
-    Tasks are enqueued in size-descending (LPT) order of ``cost`` (ties
+    Tasks are fed in size-descending (LPT) order of ``cost`` (ties
     broken by ascending ``gi``, so the order is deterministic) onto the
     shared queue; idle workers steal from the head. Returns
     ``(records, backlog_hwms)`` where each record is the raw ``result``
@@ -308,30 +492,10 @@ class WorkerPool:
     :class:`WriteBackError` after the phase fully drains (so the pool
     stays reusable even when a task fails).
     """
-    if self._closed:
-      raise PoolBroken('pool already shut down')
-    ordered = sorted(items, key=lambda it: (-it[2], it[0]))
-    for pos, (gi, task, _cost) in enumerate(ordered):
-      self._task_q.put(('task', fn, gi, task, pos))
-    records = []
-    for _ in range(len(ordered)):
-      msg = self._next_result()
-      records.append(msg)
-      if on_result is not None:
-        on_result(msg)
-    # Flush round: exactly num_workers tokens, each consumed by a distinct
-    # worker (a worker that took one parks on the barrier and cannot take
-    # another), so every worker's write-back queue is provably drained
-    # before the phase's results are treated as durable.
-    for _ in range(self.num_workers):
-      self._task_q.put(('flush',))
-    hwms, flush_errs = [], []
-    for _ in range(self.num_workers):
-      msg = self._next_result()
-      hwms.append(msg[2])
-      if msg[3] is not None:
-        flush_errs.append(msg[3])
-    self._barrier_wait()
+    ordered = iter(sorted(items, key=lambda it: (-it[2], it[0])))
+    records = self.run_stream(fn, lambda: next(ordered, None),
+                              on_result=on_result)
+    hwms, flush_errs = self.flush_round()
     failed = sorted((m for m in records if m[3] is not None),
                     key=lambda m: m[1])
     if failed:
@@ -344,9 +508,11 @@ class WorkerPool:
     return records, hwms
 
   def broadcast(self, fn):
-    """Run ``fn()`` once on every worker (late warmup hooks)."""
+    """Run ``fn()`` once on every worker (late warmup hooks). Recorded
+    in the warmup history so a respawned worker replays it too."""
     if self._closed:
       raise PoolBroken('pool already shut down')
+    self._warmups.append(fn)
     for _ in range(self.num_workers):
       self._task_q.put(('call', fn))
     errs = []
@@ -370,7 +536,10 @@ class WorkerPool:
       except (OSError, ValueError):
         force = True
     for p in self._procs:
-      p.join(timeout=None if force else 10.0)
+      # force: don't wait at all — surviving workers are still blocked on
+      # the task queue (no stop token was sent) and will never exit on
+      # their own; an unbounded join here deadlocks the teardown.
+      p.join(timeout=0 if force else 10.0)
       if p.is_alive():
         p.terminate()
     for p in self._procs:
@@ -378,3 +547,4 @@ class WorkerPool:
         p.join(timeout=10.0)
     self._task_q.close()
     self._result_q.close()
+    shutil.rmtree(self._scratch, ignore_errors=True)

@@ -54,7 +54,10 @@ def scatter_partition(lines, src_index, num_targets, spill_dir, seed,
       continue
     tgt_dir = os.path.join(spill_dir, f'tgt{j}')
     os.makedirs(tgt_dir, exist_ok=True)
-    tmp = os.path.join(tgt_dir, f'.src{src_index}.tmp')
+    # pid-unique tmp: an elastic re-execution of this scatter task may
+    # briefly overlap the revoked owner; distinct tmps keep both renames
+    # well-formed (identical bytes either way — scatter is seeded).
+    tmp = os.path.join(tgt_dir, f'.src{src_index}.{os.getpid()}.tmp')
     with open(tmp, 'w', encoding='utf-8', newline='') as f:
       f.write(delimiter.join(bucket))
       f.write(delimiter)

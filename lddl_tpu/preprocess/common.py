@@ -90,7 +90,12 @@ def run_shuffled(corpus, sink_dir, process_partition, seed, executor=None,
       executor.set_warmup(warmup, key=warmup_key)
     os.makedirs(sink_dir, exist_ok=True)
     spill_dir = os.path.join(sink_dir, '_shuffle_spill')
-    if executor.comm.rank == 0 and os.path.isdir(spill_dir):
+    # A restarted elastic run resumes from the scatter phase's completion
+    # manifests — the spills backing already-manifested scatter tasks are
+    # inputs the resume still needs, so only pre-clean when this is a
+    # fresh (or statically scheduled) run.
+    resuming = executor.resume_pending('scatter')
+    if not resuming and executor.comm.rank == 0 and os.path.isdir(spill_dir):
       shutil.rmtree(spill_dir)
     executor.comm.barrier()
     n = shuffle_corpus(

@@ -221,6 +221,21 @@ def goodput_meters(merged):
   out['queue_depth'] = _gauge(metrics, 'loader.queue_depth')
   out['shm_slot_occupancy'] = _gauge(metrics, 'loader.shm_slot_occupancy')
   out['writer_backlog'] = _gauge(metrics, 'pipeline.pool.writer_backlog')
+
+  # Fault-tolerance meters: lease churn of the elastic executor plus the
+  # local recovery counters (pool respawns, retried comm IO). All-zero
+  # (the healthy fast path) reports None so dashboards stay quiet.
+  ft = {
+      'claims': _counter_total(metrics, 'pipeline.elastic.claims'),
+      'reexecutions': _counter_total(metrics,
+                                     'pipeline.elastic.reexecutions'),
+      'revokes': _counter_total(metrics, 'pipeline.elastic.revokes'),
+      'resume_skipped': _counter_total(metrics,
+                                       'pipeline.elastic.resume_skipped'),
+      'pool_respawns': _counter_total(metrics, 'pipeline.pool.respawns'),
+      'io_retries': _counter_total(metrics, 'comm.io_retries'),
+  }
+  out['fault_tolerance'] = ft if any(ft.values()) else None
   return out
 
 

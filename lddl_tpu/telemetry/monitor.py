@@ -150,6 +150,10 @@ def render_frame(fleet, clear=True):
         meters.append(f'{g} {good[g]["mean"]:.1f}')
     if meters:
       out.append('  goodput: ' + ' · '.join(meters))
+    ft = good.get('fault_tolerance')
+    if ft:
+      parts = [f'{k.replace("_", "-")} {v}' for k, v in ft.items() if v]
+      out.append('  fault-tolerance: ' + ' · '.join(parts))
   strag = fleet.get('straggler')
   if strag:
     out.append('')

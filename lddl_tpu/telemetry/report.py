@@ -273,6 +273,23 @@ def render_report(merged):
       out.append(f'  attention tiles: {tiles} total, {skipped} skipped '
                  f'({100 * skipped / tiles:.1f}% block-diagonal skip)')
 
+  ft_counters = {
+      'partitions claimed': 'pipeline.elastic.claims',
+      'partitions re-executed': 'pipeline.elastic.reexecutions',
+      'leases revoked': 'pipeline.elastic.revokes',
+      'resume-skipped': 'pipeline.elastic.resume_skipped',
+      'pool workers respawned': 'pipeline.pool.respawns',
+      'comm IO retries': 'comm.io_retries',
+  }
+  ft_lines = []
+  for title, name in ft_counters.items():
+    total = metrics.get(name, {}).get('total', 0)
+    if total:
+      ft_lines.append(f'  {title}: {total}')
+  if ft_lines:
+    out.append('\n[fault tolerance]')
+    out.extend(ft_lines)
+
   verdict = summarize_stages(merged)
   out.append('\n[bottleneck]')
   out.append(f'  {verdict["bottleneck"]}')

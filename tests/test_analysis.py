@@ -344,6 +344,89 @@ def test_lda006_exempt_in_tests():
 
 
 # ---------------------------------------------------------------------------
+# LDA007: swallowed exceptions
+
+
+def test_lda007_flags_broad_inert_handlers():
+  assert run("""
+      def claim(path):
+        try:
+          publish(path)
+        except:
+          pass
+        while True:
+          try:
+            beat()
+          except Exception:
+            continue
+        try:
+          probe()
+        except (ValueError, Exception):
+          ...
+      """) == ['LDA007', 'LDA007', 'LDA007']
+
+
+def test_lda007_clean_for_narrow_or_handled():
+  assert run("""
+      import logging
+      def recover(store, tele):
+        try:
+          store.read()
+        except OSError:
+          pass  # narrow: the one error the substrate legitimately throws
+        try:
+          store.publish()
+        except (FileExistsError, TimeoutError):
+          pass
+        try:
+          store.claim()
+        except Exception:
+          tele.counter('comm.io_retries').add(1)
+        try:
+          store.revoke()
+        except Exception as e:
+          logging.warning('revoke failed: %s', e)
+          raise
+      """) == []
+
+
+def test_lda007_docstring_only_body_is_inert():
+  assert run("""
+      def f():
+        try:
+          g()
+        except Exception:
+          'absorbed on purpose (but undeclared): still flagged'
+      """) == ['LDA007']
+
+
+def test_lda007_pragma_suppresses():
+  findings = run_findings("""
+      def f():
+        try:
+          g()
+        # lddl: noqa[LDA007] shutdown path: any error here is moot
+        except Exception:
+          pass
+      """)
+  assert [f.rule_id for f in findings] == ['LDA007']
+  assert findings[0].suppressed
+
+
+def test_lda007_exempts_tests_and_testing():
+  src = """
+      def f():
+        try:
+          g()
+        except:
+          pass
+      """
+  assert run(src, path='tests/test_something.py') == []
+  assert run(src, path='lddl_tpu/testing.py') == []
+  assert run(src) == ['LDA007']
+
+
+# ---------------------------------------------------------------------------
 # Engine / pragmas / CLI
 
 

@@ -152,3 +152,236 @@ class TestPeerDeathPublishRace:
       be._check_peer_alive(1, 1)
     # and rank 0's side of collective #0 completes normally.
     assert be.allgather_object('r0') == ['r0', 'r1']
+
+
+# ---------------------------------------------------------------------------
+# elastic executor: dead-rank re-execution, restart resume, lease revocation
+
+
+def _write_shard(out_dir, sec, seed, gi):
+  """Deterministic shard writer: output is a pure function of
+  (task, global_index), the contract the elastic byte-identity
+  guarantee rides on."""
+  import pyarrow as pa
+
+  from lddl_tpu.pipeline.parquet_io import write_shard_file
+  time.sleep(sec)
+  table = pa.table(
+      {'v': pa.array([seed * 1000 + gi * 10 + k for k in range(20)])})
+  write_shard_file(table, os.path.join(out_dir, f'part.{gi}.parquet'))
+  return ('ok', gi, seed)
+
+
+def _reference_shards(out_dir, tasks):
+  """Fault-free single-process reference run (static stride)."""
+  import functools
+
+  from lddl_tpu.pipeline.executor import Executor
+  os.makedirs(out_dir, exist_ok=True)
+  with Executor(num_local_workers=1) as ex:  # NullBackend: static path
+    return ex.map(functools.partial(_write_shard, out_dir, 0.0), tasks,
+                  label='ref')
+
+
+def _elastic_rank(rendezvous, rank, world, out_dir, tasks, env, q):
+  """One elastic rank: barrier (so both ranks are claiming before any
+  fault fires), then a lease-claimed map writing one shard per task."""
+  import functools
+  os.environ.update(env)
+  try:
+    from lddl_tpu.pipeline.executor import Executor
+    be = FileBackend(rendezvous, rank, world, timeout=60.0, run_id='el')
+    be.barrier()
+    with Executor(comm=be, num_local_workers=1) as ex:
+      out = ex.map(functools.partial(_write_shard, out_dir, 0.2), tasks,
+                   label='shards')
+    q.put((rank, 'completed', out))
+  except BaseException as e:  # noqa: BLE001 - report everything
+    q.put((rank, 'error', f'{type(e).__name__}: {e}'))
+
+
+class TestElasticRankDeath:
+
+  def test_sigkill_rank_survivor_completes_byte_identical(self, tmp_path):
+    """SIGKILL rank 1 at the start of its first claimed partition: the
+    survivor must revoke the orphaned lease via the positive death
+    probe (the 60s staleness timeout would blow the deadline), finish
+    ALL partitions, and produce shards byte-identical to a fault-free
+    static-stride run."""
+    from lddl_tpu.testing import hash_parquets
+    tasks = list(range(8))
+    out_dir = str(tmp_path / 'out')
+    ref_dir = str(tmp_path / 'ref')
+    os.makedirs(out_dir)
+    expected = _reference_shards(ref_dir, tasks)
+    env = {
+        'LDDL_LEASE_TIMEOUT': '60',  # force the death-probe path
+        'LDDL_COMM_HEARTBEAT': '0.2',
+    }
+    ctx = multiprocessing.get_context('spawn')
+    q = ctx.Queue()
+    procs = []
+    for r in range(2):
+      renv = dict(env)
+      renv['LDDL_FAULTS'] = ('kill:elastic.task:rank=1,nth=1'
+                             if r == 1 else '')
+      procs.append(ctx.Process(
+          target=_elastic_rank,
+          args=(str(tmp_path / 'rdv'), r, 2, out_dir, tasks, renv, q),
+          daemon=True))
+    t0 = time.monotonic()
+    for p in procs:
+      p.start()
+    rank, kind, out = q.get(timeout=120)
+    elapsed = time.monotonic() - t0
+    for p in procs:
+      p.join(timeout=30)
+    assert rank == 0 and kind == 'completed', (rank, kind, out)
+    assert out == expected  # gather saw every partition, task-ordered
+    assert procs[1].exitcode == -signal.SIGKILL
+    assert hash_parquets(out_dir) == hash_parquets(ref_dir), \
+        'surviving-rank shards diverged from the fault-free run'
+    assert elapsed < 60.0, (
+        f'survivor took {elapsed:.0f}s — dead-rank re-execution must ride '
+        'the death probe, not the lease timeout')
+
+
+def _resume_rank(rendezvous, out_dir, tasks, env, q):
+  """World-1 elastic run for the kill-then-restart resume test."""
+  import functools
+  os.environ.update(env)
+  try:
+    from lddl_tpu.pipeline.executor import Executor
+    be = FileBackend(rendezvous, 0, 1, timeout=60.0, run_id='resume')
+    with Executor(comm=be, num_local_workers=1) as ex:
+      out = ex.map(functools.partial(_write_shard, out_dir, 0.0), tasks,
+                   label='shards')
+    q.put(('completed', out))
+  except BaseException as e:  # noqa: BLE001 - report everything
+    q.put(('error', f'{type(e).__name__}: {e}'))
+
+
+class TestElasticRestartResume:
+
+  def test_killed_run_resumes_skipping_manifested_partitions(self,
+                                                             tmp_path):
+    """Kill a world-1 elastic preprocess on its third partition, restart
+    it with the same run id: already-manifested partitions must be
+    skipped (shard files untouched — same inode and mtime), the killed
+    partition re-executed, and the final output byte-identical to a
+    fault-free run."""
+    from lddl_tpu.testing import hash_parquets
+    tasks = list(range(6))
+    out_dir = str(tmp_path / 'out')
+    ref_dir = str(tmp_path / 'ref')
+    rdv = str(tmp_path / 'rdv')
+    os.makedirs(out_dir)
+    expected = _reference_shards(ref_dir, tasks)
+    env = {
+        # 'once': the marker in LDDL_FAULTS_DIR survives the restart, so
+        # the SAME spec is armed in both incarnations but fires in one.
+        'LDDL_FAULTS': 'kill:elastic.task:nth=3,once',
+        'LDDL_FAULTS_DIR': str(tmp_path / 'faults'),
+        'LDDL_WRITE_BACK': '0',  # synchronous shards+manifests: the
+        # manifested set at death is exactly the finished partitions
+        'LDDL_COMM_HEARTBEAT': '0.2',
+    }
+    os.makedirs(env['LDDL_FAULTS_DIR'])
+    ctx = multiprocessing.get_context('spawn')
+    q = ctx.Queue()
+    p1 = ctx.Process(target=_resume_rank,
+                     args=(rdv, out_dir, tasks, env, q), daemon=True)
+    p1.start()
+    p1.join(timeout=120)
+    assert p1.exitcode == -signal.SIGKILL, \
+        'first incarnation should have been killed by the injected fault'
+    survivors = {
+        name: (st.st_ino, st.st_mtime_ns)
+        for name in os.listdir(out_dir)
+        for st in [os.stat(os.path.join(out_dir, name))]
+        if name.endswith('.parquet')
+    }
+    assert len(survivors) == 2, (
+        f'two partitions should have completed before the kill: '
+        f'{sorted(survivors)}')
+    p2 = ctx.Process(target=_resume_rank,
+                     args=(rdv, out_dir, tasks, env, q), daemon=True)
+    p2.start()
+    kind, out = q.get(timeout=120)
+    p2.join(timeout=30)
+    assert kind == 'completed', out
+    assert out == expected
+    assert hash_parquets(out_dir) == hash_parquets(ref_dir), \
+        'resumed shards diverged from the fault-free run'
+    for name, (ino, mtime) in survivors.items():
+      st = os.stat(os.path.join(out_dir, name))
+      assert (st.st_ino, st.st_mtime_ns) == (ino, mtime), (
+          f'{name} was manifested before the kill but rewritten by the '
+          'resume — manifest skipping is not working')
+
+
+class TestLeaseRevokeDeterminism:
+
+  def test_all_survivors_reach_same_revoke_decision(self, tmp_path):
+    """Two survivors observing the same orphaned claim (owner never
+    heartbeats, beacon absent) must both decide to revoke after the
+    lease timeout, agree on the generation, and race the re-claim down
+    to exactly one winner via CAS."""
+    from lddl_tpu.pipeline.executor import _LeaseClaimer
+    be0 = FileBackend(str(tmp_path), 0, 3, timeout=60.0, run_id='rv')
+    be1 = FileBackend(str(tmp_path), 1, 3, timeout=60.0, run_id='rv')
+    s0 = be0.lease_store('ph.0')
+    s1 = be1.lease_store('ph.0')
+    # Orphaned claim: partition 5 owned by rank 2, which never started
+    # (no beacon, no heartbeat) — only the staleness path can free it.
+    s0.publish('claim.5.g0', b'2')
+    c0 = _LeaseClaimer(s0, [5], timeout=0.5)
+    c1 = _LeaseClaimer(s1, [5], timeout=0.5)
+    assert c0.next_claim() is None and c1.next_claim() is None
+    # First sweep only *records* the silent heartbeat: a survivor that
+    # just arrived must not revoke on zero observation time.
+    assert c0.observe() is False and c1.observe() is False
+    time.sleep(0.7)
+    assert c0.observe() is True and c1.observe() is True
+    assert c0._gen[5] == c1._gen[5] == 1, \
+        'survivors diverged on the claim generation'
+    revokes = [k for k in s0.list('revoke.') if k.startswith('revoke.5.')]
+    assert revokes == ['revoke.5.g0'], \
+        'the revoke CAS must leave exactly one revocation record'
+    wins = [c for c in (c0, c1) if c.next_claim() == 5]
+    assert len(wins) == 1, 're-claim after revocation must have one winner'
+
+
+class TestCommRetryAndKnobs:
+
+  def test_injected_write_error_is_retried(self, tmp_path, monkeypatch):
+    """A transient OSError out of the atomic-write path (first attempt
+    only) must be absorbed by the bounded retry, invisibly to the
+    caller."""
+    from lddl_tpu.core import faults
+    from lddl_tpu.telemetry import disable, enable
+    faults.reset()
+    monkeypatch.setenv('LDDL_FAULTS', 'raise:comm.write:nth=1')
+    tele = enable()
+    retries = tele.counter('comm.io_retries')
+    before = retries.total
+    be = FileBackend(str(tmp_path), 0, 1, timeout=10.0, run_id='retry')
+    assert be.allgather_object('payload') == ['payload']
+    assert retries.total > before, \
+        'the injected first-attempt failure should have counted a retry'
+    faults.reset()
+    disable()
+
+  def test_timeout_and_heartbeat_env_knobs(self, tmp_path, monkeypatch):
+    from lddl_tpu.comm import comm_heartbeat_interval, comm_timeout
+    monkeypatch.setenv('LDDL_COMM_TIMEOUT', '7.5')
+    monkeypatch.setenv('LDDL_COMM_HEARTBEAT', '0.25')
+    assert comm_timeout() == 7.5
+    assert comm_heartbeat_interval() == 0.25
+    be = FileBackend(str(tmp_path), 0, 1, run_id='knobs')
+    assert be._timeout == 7.5
+    assert be._liveness_interval == 0.25
+    monkeypatch.setenv('LDDL_COMM_HEARTBEAT', '0.0001')
+    assert comm_heartbeat_interval() == 0.05  # clamped: probe floor
+    monkeypatch.setenv('LDDL_COMM_TIMEOUT', 'junk')
+    assert comm_timeout() == 120.0

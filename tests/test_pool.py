@@ -255,3 +255,51 @@ def test_progress_final_record_marks_complete(tmp_path, monkeypatch):
   assert payload['complete'] is True
   assert payload['workers'] == 2
   assert payload['done'] == payload['total'] == 6
+
+
+def _kill_once(marker, task, idx):
+  """SIGKILL this worker on task 3 — but only the first time (the marker
+  file is the cross-process memory; env is useless here, forkserver
+  workers snapshot the environment at pool start)."""
+  import signal
+  if idx == 3 and not os.path.exists(marker):
+    open(marker, 'w').close()
+    os.kill(os.getpid(), signal.SIGKILL)
+  return task * 100 + idx
+
+
+def _kill_always(marker, task, idx):
+  import signal
+  if idx == 3:
+    os.kill(os.getpid(), signal.SIGKILL)
+  return task * 100 + idx
+
+
+class TestWorkerRespawn:
+
+  def test_single_worker_death_respawns_and_retries(self, tmp_path):
+    """A worker SIGKILLed mid-task (the transient-OOM shape) is
+    respawned and its in-flight task retried once; the phase completes
+    with full results and the pool stays usable."""
+    from lddl_tpu.telemetry import disable, enable
+    tele = enable()
+    try:
+      task = functools.partial(_kill_once, str(tmp_path / 'killed'))
+      with Executor(num_local_workers=2) as ex:
+        out = ex.map(task, list(range(8)))
+        assert out == [t * 100 + i for i, t in enumerate(range(8))]
+        assert tele.counter('pipeline.pool.respawns').total == 1
+        # same pool, next phase: the respawned worker participates
+        assert ex.map(_mix, [5, 6, 7]) == [500, 601, 702]
+    finally:
+      disable()
+
+  def test_task_killing_worker_twice_breaks_pool(self, tmp_path):
+    """A task that kills its worker on every attempt is systemic, not
+    transient: after the single retry the pool must escalate instead of
+    respawning forever."""
+    from lddl_tpu.pipeline.pool import PoolBroken
+    task = functools.partial(_kill_always, str(tmp_path / 'unused'))
+    with Executor(num_local_workers=2) as ex:
+      with pytest.raises(PoolBroken, match='killed its (respawned )?worker|twice'):
+        ex.map(task, list(range(8)))
