@@ -87,9 +87,10 @@ def _lint_status():
   omits the fields.
   """
   try:
-    from lddl_tpu.analysis import analyze_package
+    from lddl_tpu.analysis import LINT_SCHEMA_VERSION, analyze_package
     unsuppressed, suppressed = analyze_package()
     return {
+        'lint_schema': LINT_SCHEMA_VERSION,
         'lint_clean': not unsuppressed,
         'lint_findings': len(unsuppressed),
         'lint_suppressed': len(suppressed),

@@ -4,17 +4,26 @@ resource-safety invariants (the ``lddl-analyze`` linter).
 The correctness story of this codebase rests on properties no runtime
 test can fully cover: every rank derives the identical sample plan
 without communication, all randomness flows through seeded helpers,
-collectives are issued uniformly, and a killed worker leaks nothing.
-This package turns those conventions into an AST-based check that runs
-in tier-1 (``tests/test_analysis_self.py``), so refactors cannot
-silently erode them.
+collectives are issued uniformly — even through call chains — the
+elastic path never blocks on a peer, and jit-compiled code never syncs
+the host. This package turns those conventions into an AST-based check
+that runs in tier-1 (``tests/test_analysis_self.py``), so refactors
+cannot silently erode them.
 
 Layout:
-  - :mod:`.engine`: parse + single ancestor-tracking walk, import-alias
-    resolution, pragma suppression;
-  - :mod:`.rules`: the LDA001-LDA005 ruleset;
-  - :mod:`.findings`: the finding model (file:line, rule id, fix hint);
+  - :mod:`.engine`: parse + single ancestor-tracking walk, import/local
+    alias resolution, per-module facts export, pragma suppression, the
+    (parallel) per-file driver;
+  - :mod:`.project`: whole-program index — import/method resolution
+    across modules, ``ProjectRule`` base, ``analyze_project``;
+  - :mod:`.callgraph`: deterministic call graph, transitive effect
+    sets, call-chain traces;
+  - :mod:`.rules`: the per-file LDA001–LDA007 and interprocedural
+    LDA008–LDA011 rulesets;
+  - :mod:`.findings`: the finding model (file:line, rule id, fix hint,
+    call chain);
   - :mod:`.pragmas`: inline ``# lddl: noqa[LDAxxx]`` suppressions;
+  - :mod:`.sarif`: SARIF 2.1.0 rendering for CI annotation;
   - :mod:`.cli`: the ``lddl-analyze`` console entry point.
 """
 
@@ -27,28 +36,38 @@ from .engine import (
     analyze_source,
 )
 from .findings import Finding
-from .rules import default_rules, rules_by_id
+from .project import ProjectRule, analyze_project
+from .rules import all_rules, default_rules, project_rules, rules_by_id
+
+# Schema of the lint status dict / --format json document.
+LINT_SCHEMA_VERSION = 2
 
 
-def analyze_package(rules=None):
-  """Run the linter over the installed ``lddl_tpu`` tree itself.
+def analyze_package(rules=None, jobs=None):
+  """Run the analyzer — project mode, full call graph — over the
+  installed ``lddl_tpu`` tree itself.
 
   Returns ``(unsuppressed, suppressed)`` finding lists — the self-check
   test and ``bench.py``'s lint-status stamp both go through here.
   """
   root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-  findings, _ = analyze_paths([root], rules=rules)
+  findings, _ = analyze_project([root], rules=rules, jobs=jobs)
   return ([f for f in findings if not f.suppressed],
           [f for f in findings if f.suppressed])
 
 
 __all__ = [
     'Finding',
+    'LINT_SCHEMA_VERSION',
+    'ProjectRule',
     'Rule',
+    'all_rules',
     'analyze_file',
     'analyze_package',
     'analyze_paths',
+    'analyze_project',
     'analyze_source',
     'default_rules',
+    'project_rules',
     'rules_by_id',
 ]

@@ -3,16 +3,31 @@
 Usage::
 
   lddl-analyze [paths...]              # default: lddl_tpu/ if it exists
-  lddl-analyze --json lddl_tpu/        # machine-readable findings
-  lddl-analyze --rule LDA001,LDA004 .  # subset of rules
+  lddl-analyze --format json lddl_tpu/ # machine-readable findings
+  lddl-analyze --format sarif .        # SARIF 2.1.0 for CI annotations
+  lddl-analyze --rule LDA001,LDA009 .  # subset of rules
+  lddl-analyze --no-project pkg/       # per-file rules only
+  lddl-analyze --jobs 8 .              # worker count for the file pass
   lddl-analyze --changed               # only files changed vs HEAD
   lddl-analyze --changed --diff-base main~3
   lddl-analyze --list-rules
 
+Directory targets analyze in **project mode** by default: on top of the
+per-file rules, the whole-program pass builds a cross-module call graph
+and runs the interprocedural rules (LDA008–LDA011), attaching a
+``via: a() → b() → allgather at path:L`` call-chain trace to each
+finding. ``--no-project`` restricts to the per-file rules;
+``--project`` forces the whole-program pass even for file targets.
+``--changed`` implies ``--no-project`` unless ``--project`` is given
+(a partial file list can't support whole-program claims); with both,
+the graph is built over the full tree and only findings in changed
+files are reported.
+
 Exit status: 0 when every finding is pragma-suppressed (or none exist),
 1 when unsuppressed findings remain, 2 on usage errors. The tier-1
 self-check (``tests/test_analysis_self.py``) asserts exit-0 over
-``lddl_tpu/`` itself, making the linter a standing gate for every PR.
+``lddl_tpu/`` itself — in project mode — making the analyzer a standing
+gate for every PR.
 """
 
 import argparse
@@ -21,10 +36,12 @@ import os
 import subprocess
 import sys
 
-from .engine import analyze_file, discover_py_files
-from .rules import default_rules, rules_by_id
+from .engine import Rule, analyze_paths, discover_py_files
+from .project import ProjectRule, analyze_project
+from .rules import all_rules, rules_by_id
+from .sarif import to_sarif
 
-JSON_SCHEMA_VERSION = 1
+JSON_SCHEMA_VERSION = 2
 
 
 def _git_changed_files(diff_base):
@@ -52,11 +69,23 @@ def build_parser():
   parser.add_argument('paths', nargs='*',
                       help='files or directories to analyze '
                       '(default: ./lddl_tpu when present, else .)')
+  parser.add_argument('--format', default=None, dest='fmt',
+                      choices=('text', 'json', 'sarif'),
+                      help='output format (default: text)')
   parser.add_argument('--json', action='store_true', dest='as_json',
-                      help='emit one JSON object instead of text')
+                      help='shorthand for --format json')
+  parser.add_argument('--project', action='store_true', default=None,
+                      help='force the whole-program (call-graph) pass; '
+                      'default: on for directory targets')
+  parser.add_argument('--no-project', action='store_false',
+                      dest='project',
+                      help='per-file rules only')
+  parser.add_argument('--jobs', type=int, default=None,
+                      help='worker processes for the per-file pass '
+                      '(default: $LDDL_ANALYZE_JOBS or CPU count)')
   parser.add_argument('--rule', default=None,
                       help='comma-separated rule ids to run '
-                      '(e.g. LDA001,LDA004); default: all')
+                      '(e.g. LDA001,LDA009); default: all')
   parser.add_argument('--changed', action='store_true',
                       help='only analyze files git reports as changed '
                       'or untracked (fast local runs)')
@@ -72,8 +101,9 @@ def build_parser():
 
 
 def _select_rules(spec):
+  """Rule instances for a ``--rule`` spec (None = all), or an error."""
   if not spec:
-    return default_rules(), None
+    return None, None
   by_id = rules_by_id()
   wanted = [r.strip().upper() for r in spec.split(',') if r.strip()]
   unknown = [r for r in wanted if r not in by_id]
@@ -86,12 +116,14 @@ def _select_rules(spec):
 def main(args=None):
   opts = build_parser().parse_args(args)
   if opts.list_rules:
-    for rule in default_rules():
-      print(f'{rule.rule_id}  {rule.name}')
+    for rule in all_rules():
+      scope = ('project' if isinstance(rule, ProjectRule) else 'file')
+      print(f'{rule.rule_id}  {rule.name}  [{scope}]')
       print(f'    protects: {rule.invariant}')
       print(f'    fix: {rule.hint}')
     return 0
 
+  fmt = opts.fmt or ('json' if opts.as_json else 'text')
   rules, err = _select_rules(opts.rule)
   if err:
     print(f'lddl-analyze: {err}', file=sys.stderr)
@@ -115,35 +147,60 @@ def main(args=None):
             file=sys.stderr)
       return 2
 
-  files = discover_py_files(paths)
-  if file_filter is not None:
-    files = [f for f in files if os.path.abspath(f) in file_filter]
-  findings = []
-  for f in files:
-    findings.extend(analyze_file(f, rules=rules))
+  project_mode = opts.project
+  if project_mode is None:
+    selected_project_rule = bool(rules) and any(
+        isinstance(r, ProjectRule) for r in rules)
+    project_mode = (not opts.changed and
+                    (any(os.path.isdir(p) for p in paths)
+                     or selected_project_rule))
+
+  if project_mode:
+    findings, files_scanned = analyze_project(paths, rules=rules,
+                                              jobs=opts.jobs)
+    if file_filter is not None:
+      findings = [f for f in findings
+                  if os.path.abspath(f.path) in file_filter]
+  else:
+    file_rules = (None if rules is None
+                  else [r for r in rules if isinstance(r, Rule)])
+    if file_filter is not None:
+      files = [f for f in discover_py_files(paths)
+               if os.path.abspath(f) in file_filter]
+      findings, files_scanned = analyze_paths(files, rules=file_rules,
+                                              jobs=opts.jobs)
+    else:
+      findings, files_scanned = analyze_paths(paths, rules=file_rules,
+                                              jobs=opts.jobs)
 
   unsuppressed = [f for f in findings if not f.suppressed]
   suppressed = [f for f in findings if f.suppressed]
+  exit_code = 0 if not unsuppressed else 1
 
-  if opts.as_json:
+  if fmt == 'json':
     print(json.dumps({
         'version': JSON_SCHEMA_VERSION,
-        'files_scanned': len(files),
+        'mode': 'project' if project_mode else 'files',
+        'files_scanned': files_scanned,
         'findings': [f.as_dict() for f in findings],
         'num_findings': len(unsuppressed),
         'num_suppressed': len(suppressed),
         'clean': not unsuppressed,
     }))
-    return 0 if not unsuppressed else 1
+    return exit_code
+  if fmt == 'sarif':
+    print(json.dumps(to_sarif(findings, all_rules())))
+    return exit_code
 
   shown = findings if opts.show_suppressed else unsuppressed
   for f in shown:
     print(f.render())
   state = 'clean' if not unsuppressed else 'DIRTY'
-  print(f'lddl-analyze: {len(files)} files, '
+  mode = 'project' if project_mode else 'files'
+  print(f'lddl-analyze: {files_scanned} files ({mode} mode), '
         f'{len(unsuppressed)} finding(s), '
         f'{len(suppressed)} suppressed — {state}')
-  return 0 if not unsuppressed else 1
+  return exit_code
 
 
 if __name__ == '__main__':

@@ -19,10 +19,36 @@ scope/taint pre-passes), ``on_node`` runs for every AST node.
 """
 
 import ast
+import concurrent.futures
+import dataclasses
+import multiprocessing
 import os
 
 from .findings import Finding, sort_findings
 from .pragmas import is_suppressed, pragma_lines
+
+# Identifiers that gate code on rank identity (shared with rules.LDA005
+# and the interprocedural LDA008: both must agree on what "rank-
+# conditional" means or findings would shift between modes).
+RANK_IDENTS = frozenset({
+    'process_index', 'process_id', 'is_primary', 'is_coordinator',
+    'is_main_process',
+})
+
+
+def rank_mention(test):
+  """First identifier in ``test`` that smells like a rank check, or
+  None. Matches bare/attribute names containing ``rank`` and the
+  conventional jax/launcher spellings in :data:`RANK_IDENTS`."""
+  for node in ast.walk(test):
+    ident = None
+    if isinstance(node, ast.Name):
+      ident = node.id
+    elif isinstance(node, ast.Attribute):
+      ident = node.attr
+    if ident and ('rank' in ident.lower() or ident in RANK_IDENTS):
+      return ident
+  return None
 
 
 class Rule:
@@ -69,6 +95,7 @@ class ModuleContext:
     # Normalized forward-slash path for rule exemption matching.
     self.norm_path = os.path.abspath(path).replace(os.sep, '/')
     self.aliases = _import_aliases(tree)
+    self.aliases.update(_local_aliases(tree, self.aliases))
     self.ancestors = ()  # set by the walker before each on_node dispatch
 
   def path_is(self, *fragments):
@@ -139,6 +166,73 @@ def _import_aliases(tree):
   return aliases
 
 
+def _qual_of(node, aliases):
+  """Dotted name of a Name/Attribute chain resolved through ``aliases``,
+  or None (standalone twin of :meth:`ModuleContext.qualname`)."""
+  parts = []
+  while isinstance(node, ast.Attribute):
+    parts.append(node.attr)
+    node = node.value
+  if not isinstance(node, ast.Name):
+    return None
+  parts.append(aliases.get(node.id, node.id))
+  return '.'.join(reversed(parts))
+
+
+def _local_aliases(tree, import_aliases):
+  """local name -> canonical dotted origin for simple rebindings.
+
+  ``rng = random`` or ``jit = jax.jit`` makes every later use of the
+  new name opaque to pure import-alias resolution — the known
+  false-negative hole in LDA002/LDA005. A name qualifies only when it
+  is bound exactly once in the whole module (any rebinding, loop
+  target, or parameter shadow disqualifies it) and that one binding is
+  a plain ``x = name.chain`` assignment, so the alias can never be
+  stale. Alias-of-alias chains resolve via a short fixed point.
+  """
+  bind_counts = {}
+
+  def bump(name):
+    bind_counts[name] = bind_counts.get(name, 0) + 1
+
+  for node in ast.walk(tree):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+      bump(node.name)
+    elif isinstance(node, (ast.Import, ast.ImportFrom)):
+      for a in node.names:
+        bump((a.asname or a.name).split('.')[0])
+    elif isinstance(node, ast.arg):
+      bump(node.arg)
+    elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                   (ast.Store, ast.Del)):
+      bump(node.id)
+
+  candidates = {}
+  for node in ast.walk(tree):
+    if (isinstance(node, ast.Assign) and len(node.targets) == 1
+        and isinstance(node.targets[0], ast.Name)
+        and isinstance(node.value, (ast.Name, ast.Attribute))
+        and bind_counts.get(node.targets[0].id, 0) == 1):
+      candidates[node.targets[0].id] = node.value
+
+  out = {}
+  for _ in range(3):  # bounded fixed point for alias-of-alias chains
+    changed = False
+    merged = dict(import_aliases)
+    merged.update(out)
+    for name, value in sorted(candidates.items()):
+      if name in out:
+        continue
+      dotted = _qual_of(value, merged)
+      if dotted and dotted.split('.')[0] != name:
+        out[name] = dotted
+        changed = True
+    if not changed:
+      break
+  return out
+
+
 def walk_with_ancestors(tree):
   """Yield ``(node, ancestors)`` for every node; ancestors are outermost
   first and exclude the node itself."""
@@ -149,6 +243,365 @@ def walk_with_ancestors(tree):
     child_anc = anc + (node,)
     for child in ast.iter_child_nodes(node):
       stack.append((child, child_anc))
+
+
+# ---------------------------------------------------------------------------
+# Per-module facts export (project mode).
+#
+# ``extract_module_facts`` distills one parsed module into the flat,
+# picklable facts the whole-program layer needs: every definition with
+# its resolved calls, lexical effects, decorators, and branch structure.
+# The project index (analysis/project.py) links these across modules
+# into a call graph; nothing here looks outside the file.
+# ---------------------------------------------------------------------------
+
+# Cross-rank collective operations (the repo's comm vocabulary plus the
+# jax multihost spellings). Shared with rules.LDA005/LDA008/LDA009.
+COLLECTIVES = frozenset({
+    'allgather_object', 'allreduce_sum', 'broadcast_object', 'barrier',
+    'allreduce', 'allgather', 'broadcast', 'reduce_scatter', 'all_to_all',
+    'sync_global_devices', 'process_allgather',
+})
+
+# Dotted prefixes whose ``allgather``/``all_to_all``-style terminals are
+# *device* collectives (legal inside jit/shard_map), not host-blocking
+# cross-rank ones.
+DEVICE_COLLECTIVE_PREFIXES = ('numpy.', 'jax.lax.', 'jax.numpy.')
+
+# Wrappers whose function argument becomes traced/compiled code.
+JIT_WRAPPERS = frozenset({'jit', 'shard_map', 'pallas_call',
+                          'CompiledStepCache'})
+
+# ``x.join()`` / ``x.wait()`` / ``x.get()`` / ``x.acquire()`` with *no*
+# arguments: a wait with no timeout, unbounded by construction. The
+# zero-arg requirement keeps ``os.path.join(a, b)``, ``sep.join(parts)``
+# and ``q.get(timeout=...)`` out.
+UNBOUNDED_WAIT_ATTRS = frozenset({'join', 'wait', 'acquire', 'get'})
+
+
+@dataclasses.dataclass
+class CallSite:
+  """One call expression inside a definition."""
+  dotted: str        # alias-resolved dotted name ('' when unresolvable)
+  terminal: str      # last name segment (always available)
+  receiver: str      # dotted chain of an attribute call's receiver, or ''
+  line: int
+  col: int
+  nargs: int
+  nkw: int
+  arg0: str          # dotted name of first positional arg, or ''
+  rank_cond: str     # gating rank identifier when under a rank branch
+
+
+@dataclasses.dataclass
+class EffectSite:
+  """One lexical effect (collective, host_sync, ...) at a location."""
+  kind: str
+  detail: str
+  line: int
+  col: int
+
+
+@dataclasses.dataclass
+class BranchFacts:
+  """One ``if`` statement and the call indices in each arm, in source
+  order (indices into the owning DefFacts.calls)."""
+  line: int
+  body: list
+  orelse: list
+
+
+@dataclasses.dataclass
+class DefFacts:
+  """One function/method definition."""
+  qualname: str      # dotted within the module ('Executor._map_elastic')
+  line: int
+  cls: str           # immediately enclosing class qualname, or ''
+  decorators: tuple  # resolved dotted decorator names
+  calls: list        # [CallSite]
+  effects: list      # [EffectSite]
+  var_ctors: dict    # local var -> dotted ctor name it was built from
+  branches: list     # [BranchFacts]
+
+
+@dataclasses.dataclass
+class ClassFacts:
+  qualname: str
+  line: int
+  bases: tuple       # resolved dotted base names
+  attr_ctors: dict   # 'self.X = Ctor(...)' in any method -> {X: ctor}
+
+
+@dataclasses.dataclass
+class ModuleFacts:
+  path: str
+  defs: dict         # def qualname -> DefFacts
+  classes: dict      # class qualname -> ClassFacts
+  jit_roots: list    # [(arg0_dotted, scope_qualname, line)] from
+                     # jit(f)/shard_map(f)/pallas_call(f)/CompiledStepCache(f)
+  aliases: dict      # local name -> dotted origin (for re-export chasing)
+
+
+def _scope_chain(ancestors, node):
+  """Enclosing def/class AST nodes of ``node``, outermost first,
+  counting only scopes entered through their *body*: a node hanging off
+  a def's decorator list or signature belongs to the outer scope —
+  decorators evaluate at definition time, not inside the function."""
+  chain = list(ancestors) + [node]
+  scopes = []
+  for i, anc in enumerate(chain[:-1]):
+    if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef)):
+      if any(chain[i + 1] is stmt for stmt in anc.body):
+        scopes.append(anc)
+  return scopes
+
+
+def _owner_def_qualname(scopes):
+  """Qualname of the innermost enclosing *function* in ``scopes`` (its
+  path may pass through classes), or '' for module/class-level code."""
+  idx = None
+  for i in range(len(scopes) - 1, -1, -1):
+    if isinstance(scopes[i], (ast.FunctionDef, ast.AsyncFunctionDef)):
+      idx = i
+      break
+  if idx is None:
+    return ''
+  return '.'.join(s.name for s in scopes[:idx + 1])
+
+
+def _arm_of(if_node, child):
+  """'body'/'orelse' when ``child`` (an immediate AST child of
+  ``if_node``) sits in that arm, else None (e.g. inside the test)."""
+  if any(child is stmt for stmt in if_node.body):
+    return 'body'
+  if any(child is stmt for stmt in if_node.orelse):
+    return 'orelse'
+  return None
+
+
+def _decorator_names(node, aliases):
+  """Resolved dotted names of a def's decorators. ``functools.partial(
+  jax.jit, ...)`` resolves to its first argument — the wrapper that
+  actually applies."""
+  out = []
+  for dec in node.decorator_list:
+    if isinstance(dec, ast.Call):
+      fn = _qual_of(dec.func, aliases) or ''
+      if fn.rsplit('.', 1)[-1] == 'partial' and dec.args:
+        inner = _qual_of(dec.args[0], aliases)
+        if inner:
+          out.append(inner)
+          continue
+      if fn:
+        out.append(fn)
+    else:
+      fn = _qual_of(dec, aliases)
+      if fn:
+        out.append(fn)
+  return tuple(out)
+
+
+def _first_fn_arg(call, aliases):
+  """Dotted name of the function a wrapper call wraps: the first
+  positional arg, unwrapping one level of ``functools.partial``."""
+  if not call.args:
+    return ''
+  a = call.args[0]
+  if isinstance(a, ast.Call):
+    fn = _qual_of(a.func, aliases) or ''
+    if fn.rsplit('.', 1)[-1] == 'partial' and a.args:
+      a = a.args[0]
+    else:
+      return ''
+  return _qual_of(a, aliases) or ''
+
+
+def _call_effects(call, dotted, terminal, receiver, aliases):
+  """Lexical ``(kind, detail)`` effects of one call expression."""
+  del aliases  # resolution already folded into ``dotted``
+  d = dotted or ''
+  nargs, nkw = len(call.args), len(call.keywords)
+  effects = []
+  # Attribute calls are collectives by method name; bare names only when
+  # alias resolution proves the origin (mirrors rules.LDA005 — a local
+  # function that happens to be named `barrier` is not one).
+  if isinstance(call.func, ast.Attribute):
+    coll = terminal if terminal in COLLECTIVES else ''
+  else:
+    coll = (d.rsplit('.', 1)[-1]
+            if '.' in d and d.rsplit('.', 1)[-1] in COLLECTIVES else '')
+  if coll and not d.startswith(DEVICE_COLLECTIVE_PREFIXES):
+    effects.append(('collective', coll))
+  if d.startswith('time.'):
+    effects.append(('wall_clock', f'{d}()'))
+  if terminal == 'item' and receiver and nargs == 0:
+    effects.append(('host_sync', f'{receiver}.item()'))
+  elif (d in ('float', 'bool') and nargs == 1
+        and not isinstance(call.args[0], ast.Constant)):
+    effects.append(('host_sync', f'{d}()'))
+  elif d in ('numpy.asarray', 'jax.device_get'):
+    effects.append(('host_sync', f'{d}()'))
+  elif terminal == 'block_until_ready':
+    effects.append(('host_sync', '.block_until_ready()'))
+  if d == 'open' or d.startswith('subprocess.'):
+    effects.append(('blocking_io', f'{d}()'))
+  if (terminal in ('Thread', 'Process')
+      and any(kw.arg == 'target' for kw in call.keywords)):
+    effects.append(('thread_spawn', terminal))
+  if (receiver and terminal in UNBOUNDED_WAIT_ATTRS
+      and nargs == 0 and nkw == 0):
+    effects.append(('unbounded_wait', f'{receiver}.{terminal}()'))
+  return effects
+
+
+def extract_module_facts(tree, path, aliases=None):
+  """Distill one parsed module into :class:`ModuleFacts`.
+
+  Calls/effects inside each definition are recorded in source order;
+  module-level and class-level statements (which run at import time,
+  uniformly on every rank) are not attributed to any definition.
+  """
+  if aliases is None:
+    aliases = _import_aliases(tree)
+    aliases.update(_local_aliases(tree, aliases))
+  defs = {}
+  classes = {}
+  jit_roots = []
+  # def qualname -> [(CallSite, [(if line, arm)])]; sorted per def at the end
+  raw_calls = {}
+  # def qualname -> {if line: If node}
+  def_ifs = {}
+
+  for node, ancestors in walk_with_ancestors(tree):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      scopes = _scope_chain(ancestors, node)
+      qual = '.'.join([s.name for s in scopes] + [node.name])
+      cls = ''
+      if scopes and isinstance(scopes[-1], ast.ClassDef):
+        cls = '.'.join(s.name for s in scopes)
+      if qual not in defs:
+        defs[qual] = DefFacts(
+            qualname=qual, line=node.lineno, cls=cls,
+            decorators=_decorator_names(node, aliases),
+            calls=[], effects=[], var_ctors={}, branches=[])
+      continue
+    if isinstance(node, ast.ClassDef):
+      scopes = _scope_chain(ancestors, node)
+      qual = '.'.join([s.name for s in scopes] + [node.name])
+      bases = tuple(b for b in (_qual_of(b, aliases) for b in node.bases)
+                    if b)
+      if qual not in classes:
+        classes[qual] = ClassFacts(qualname=qual, line=node.lineno,
+                                   bases=bases, attr_ctors={})
+      continue
+
+    scopes = _scope_chain(ancestors, node)
+    owner = _owner_def_qualname(scopes)
+
+    if isinstance(node, ast.Assign) and owner and owner in defs:
+      value = node.value
+      if isinstance(value, ast.IfExp):
+        # `writer = Ctor() if flag else None`: either branch may type
+        # the receiver; prefer the one that is a constructor call.
+        value = (value.body if isinstance(value.body, ast.Call)
+                 else value.orelse)
+      if isinstance(value, ast.Call):
+        ctor = _qual_of(value.func, aliases)
+        if ctor and len(node.targets) == 1:
+          tgt = node.targets[0]
+          if isinstance(tgt, ast.Name):
+            defs[owner].var_ctors.setdefault(tgt.id, ctor)
+          elif (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == 'self' and defs[owner].cls in classes):
+            classes[defs[owner].cls].attr_ctors.setdefault(tgt.attr, ctor)
+      continue
+
+    if isinstance(node, ast.If) and owner and owner in defs:
+      def_ifs.setdefault(owner, {})[node.lineno] = node
+      continue
+
+    if not isinstance(node, ast.Call):
+      continue
+
+    dotted = _qual_of(node.func, aliases) or ''
+    if isinstance(node.func, ast.Attribute):
+      terminal = node.func.attr
+      receiver = _qual_of(node.func.value, aliases) or ''
+    elif isinstance(node.func, ast.Name):
+      terminal = node.func.id
+      receiver = ''
+    else:
+      terminal, receiver = '', ''
+
+    if terminal in JIT_WRAPPERS:
+      arg0_fn = _first_fn_arg(node, aliases)
+      if arg0_fn:
+        jit_roots.append((arg0_fn, owner, node.lineno))
+
+    if not owner or owner not in defs:
+      continue
+
+    # Innermost owning def node: If-ancestors beyond it gate this call.
+    owner_node = None
+    for s in reversed(scopes):
+      if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        owner_node = s
+        break
+    arms = []
+    rank_cond = ''
+    past_owner = False
+    chain = list(ancestors) + [node]
+    for i, anc in enumerate(chain[:-1]):
+      if anc is owner_node:
+        past_owner = True
+        continue
+      if not past_owner or not isinstance(anc, ast.If):
+        continue
+      arm = _arm_of(anc, chain[i + 1])
+      if arm is None:
+        continue
+      arms.append((anc.lineno, arm))
+      if not rank_cond:
+        ident = rank_mention(anc.test)
+        if ident:
+          rank_cond = ident
+
+    arg0 = ''
+    if node.args and isinstance(node.args[0], (ast.Name, ast.Attribute)):
+      arg0 = _qual_of(node.args[0], aliases) or ''
+    site = CallSite(
+        dotted=dotted, terminal=terminal, receiver=receiver,
+        line=node.lineno, col=node.col_offset + 1,
+        nargs=len(node.args), nkw=len(node.keywords), arg0=arg0,
+        rank_cond=rank_cond)
+    raw_calls.setdefault(owner, []).append((site, arms))
+    for kind, detail in _call_effects(node, dotted, terminal, receiver,
+                                      aliases):
+      defs[owner].effects.append(
+          EffectSite(kind=kind, detail=detail, line=node.lineno,
+                     col=node.col_offset + 1))
+
+  for owner, entries in raw_calls.items():
+    entries.sort(key=lambda e: (e[0].line, e[0].col))
+    facts = defs[owner]
+    facts.calls = [site for site, _ in entries]
+    arm_map = {}  # if line -> {'body': [...], 'orelse': [...]}
+    for idx, (_, arms) in enumerate(entries):
+      for if_line, arm in arms:
+        arm_map.setdefault(if_line, {'body': [], 'orelse': []})
+        arm_map[if_line][arm].append(idx)
+    for if_line in sorted(def_ifs.get(owner, {})):
+      arms = arm_map.get(if_line, {'body': [], 'orelse': []})
+      facts.branches.append(
+          BranchFacts(line=if_line, body=arms['body'],
+                      orelse=arms['orelse']))
+  for facts in defs.values():
+    facts.effects.sort(key=lambda e: (e.line, e.col, e.kind))
+  jit_roots.sort(key=lambda r: (r[2], r[0]))
+  return ModuleFacts(path=path, defs=defs, classes=classes,
+                     jit_roots=jit_roots, aliases=dict(aliases))
 
 
 def analyze_source(source, path='<string>', rules=None):
@@ -214,13 +667,84 @@ def discover_py_files(paths):
   return sorted(set(out))
 
 
-def analyze_paths(paths, rules=None):
+# Below this many files the pool's spawn cost beats the win.
+_PARALLEL_MIN_FILES = 8
+
+
+def _analyze_file_worker(path, rule_ids=None):
+  """Top-level (picklable) per-file worker: rules travel as ids and are
+  re-instantiated from the registry inside the worker process."""
+  rules = None
+  if rule_ids is not None:
+    from .rules import rules_by_id
+    by_id = rules_by_id()
+    rules = [by_id[rid] for rid in rule_ids]
+  return analyze_file(path, rules=rules)
+
+
+def _serializable_rule_ids(rules):
+  """Rule ids when ``rules`` are stock registry instances (safe to
+  rebuild in a worker), else None — custom rule objects force the
+  serial path rather than silently analyzing with a lookalike."""
+  if rules is None:
+    return None
+  from .rules import rules_by_id
+  by_id = rules_by_id()
+  ids = []
+  for r in rules:
+    stock = by_id.get(r.rule_id)
+    if stock is None or type(stock) is not type(r):
+      return ()
+    ids.append(r.rule_id)
+  return ids
+
+
+def resolve_jobs(jobs=None):
+  """Worker count: explicit arg, else ``LDDL_ANALYZE_JOBS``, else CPU
+  count."""
+  if jobs is None:
+    try:
+      jobs = int(os.environ.get('LDDL_ANALYZE_JOBS', '0'))
+    except ValueError:
+      jobs = 0
+  return jobs if jobs and jobs > 0 else (os.cpu_count() or 1)
+
+
+def analyze_paths(paths, rules=None, jobs=None):
   """Analyze every ``.py`` file under ``paths`` (files or directories).
 
   Returns ``(findings, files_scanned)``; findings include suppressed
   ones (callers filter on ``f.suppressed``).
+
+  Files fan out across a process pool when ``jobs`` (or
+  ``LDDL_ANALYZE_JOBS``, or the CPU count) exceeds 1. Results are
+  collected in the same sorted file order the serial loop uses and each
+  file's findings are internally sorted, so the output is byte-identical
+  to the serial run at any worker count. Custom (non-registry) rule
+  instances can't travel to workers and fall back to the serial loop.
   """
   files = discover_py_files(paths)
+  jobs = resolve_jobs(jobs)
+  rule_ids = _serializable_rule_ids(rules)
+  parallel_ok = (jobs > 1 and len(files) >= _PARALLEL_MIN_FILES
+                 and rule_ids != ())
+  if parallel_ok:
+    try:
+      ctx = multiprocessing.get_context('fork')
+    except ValueError:
+      ctx = multiprocessing.get_context()
+    try:
+      with concurrent.futures.ProcessPoolExecutor(
+          max_workers=min(jobs, len(files)), mp_context=ctx) as pool:
+        per_file = list(
+            pool.map(_analyze_file_worker, files,
+                     [rule_ids] * len(files),
+                     chunksize=max(1, len(files) // (jobs * 4))))
+      findings = [f for batch in per_file for f in batch]
+      return findings, len(files)
+    except (OSError, ValueError, concurrent.futures.process
+            .BrokenProcessPool):
+      pass  # restricted environments: fall back to the serial loop
   findings = []
   for path in files:
     findings.extend(analyze_file(path, rules=rules))

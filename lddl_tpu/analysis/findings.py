@@ -13,7 +13,13 @@ import dataclasses
 
 @dataclasses.dataclass
 class Finding:
-  """One rule violation at ``path:line:col``."""
+  """One rule violation at ``path:line:col``.
+
+  Interprocedural (project-mode) findings carry a ``chain``: the call
+  path from the analysis root to the effect site, as a list of
+  ``{'name', 'path', 'line'}`` hops ending at the hazardous call itself.
+  Per-file findings leave it ``None``.
+  """
 
   rule_id: str
   path: str
@@ -23,6 +29,7 @@ class Finding:
   hint: str = ''
   end_line: int = 0  # last source line of the flagged node (pragma window)
   suppressed: bool = False
+  chain: list = None  # call-chain trace (project mode), else None
 
   def __post_init__(self):
     if not self.end_line:
@@ -32,8 +39,8 @@ class Finding:
     return f'{self.path}:{self.line}:{self.col}'
 
   def as_dict(self):
-    """JSON-stable rendering (the ``--json`` schema, one entry per
-    finding): rule, path, line, col, message, hint, suppressed."""
+    """JSON-stable rendering (the ``--json`` schema v2, one entry per
+    finding): rule, path, line, col, message, hint, suppressed, chain."""
     return {
         'rule': self.rule_id,
         'path': self.path,
@@ -42,11 +49,17 @@ class Finding:
         'message': self.message,
         'hint': self.hint,
         'suppressed': self.suppressed,
+        'chain': self.chain,
     }
 
   def render(self):
     tag = ' (suppressed)' if self.suppressed else ''
     out = f'{self.location()}: {self.rule_id}{tag}: {self.message}'
+    if self.chain:
+      hops = ' → '.join(hop['name'] for hop in self.chain[:-1])
+      last = self.chain[-1]
+      out += (f"\n    via: {hops} → {last['name']}"
+              f" at {last['path']}:{last['line']}")
     if self.hint:
       out += f'\n    hint: {self.hint}'
     return out

@@ -8,7 +8,10 @@ Syntax (anywhere in a comment)::
 A pragma on a *standalone* comment line covers the whole next logical
 line (the full multi-line statement), so a suppression and its
 (mandatory, by convention) reason can live on their own line when the
-code line has no room::
+code line has no room. When the next statement is a decorator, coverage
+extends through the decorator stack to the ``def``/``class`` signature
+line, so a pragma placed above a decorated definition suppresses
+findings anchored at the definition itself::
 
   # lddl: noqa[LDA003] timeout detection: aborting a stuck collective
   # never diverges ranks, it raises.
@@ -73,19 +76,34 @@ def pragma_lines(source):
     # Standalone comment: cover the next logical line in full (the
     # statement may span many physical lines; the flagged node can sit
     # on any of them). Comment-only lines in between — e.g. the
-    # pragma's reason text — don't count as the statement.
-    start = end = None
-    for nxt in tokens[i + 1:]:
+    # pragma's reason text — don't count as the statement. When that
+    # logical line is a decorator, keep extending through any further
+    # decorators and the ``def``/``class`` signature line they adorn:
+    # a pragma above a decorated definition must suppress findings on
+    # the definition itself, which ``ast`` anchors at the ``def`` line.
+    j = i + 1
+    while j < len(tokens):
+      start = end = None
+      first = None
+      for k in range(j, len(tokens)):
+        nxt = tokens[k]
+        if start is None:
+          if nxt.type in _TRIVIA:
+            continue
+          start = nxt.start[0]
+          first = nxt
+        end = nxt.end[0]
+        if nxt.type == tokenize.NEWLINE:
+          j = k + 1
+          break
+      else:
+        j = len(tokens)
       if start is None:
-        if nxt.type in _TRIVIA:
-          continue
-        start = nxt.start[0]
-      end = nxt.end[0]
-      if nxt.type == tokenize.NEWLINE:
         break
-    if start is not None:
       for l in range(start, end + 1):
         _merge(out, l, rules)
+      if not (first.type == tokenize.OP and first.string == '@'):
+        break
   return out
 
 

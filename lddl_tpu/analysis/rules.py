@@ -11,7 +11,10 @@ show.
 
 import ast
 
-from .engine import Rule
+from .engine import (COLLECTIVES, DEVICE_COLLECTIVE_PREFIXES, Rule,
+                     rank_mention)
+from .callgraph import is_lexical_collective
+from .project import ProjectRule, build_chain
 
 # ---------------------------------------------------------------------------
 # LDA001: unsorted filesystem iteration
@@ -287,27 +290,11 @@ class UnscopedResource(Rule):
 # LDA005: collective inside a rank-conditional branch
 
 
-_COLLECTIVES = frozenset({
-    'allgather_object', 'allreduce_sum', 'broadcast_object', 'barrier',
-    'allreduce', 'allgather', 'broadcast', 'reduce_scatter', 'all_to_all',
-    'sync_global_devices', 'process_allgather',
-})
-_RANK_IDENTS = frozenset({
-    'process_index', 'process_id', 'is_primary', 'is_coordinator',
-    'is_main_process',
-})
-
-
-def _rank_mention(test):
-  for n in ast.walk(test):
-    ident = None
-    if isinstance(n, ast.Name):
-      ident = n.id
-    elif isinstance(n, ast.Attribute):
-      ident = n.attr
-    if ident and ('rank' in ident.lower() or ident in _RANK_IDENTS):
-      return ident
-  return None
+# The collective vocabulary and rank-identifier heuristics live in the
+# engine: the facts extractor (project mode) and these lexical rules
+# must agree on them or findings would shift between modes.
+_COLLECTIVES = COLLECTIVES
+_rank_mention = rank_mention
 
 
 class RankConditionalCollective(Rule):
@@ -320,19 +307,31 @@ class RankConditionalCollective(Rule):
           'the rank-local work (logging, file writes) inside it')
 
   def on_node(self, node, ctx):
-    if not (isinstance(node, ast.Call) and
-            isinstance(node.func, ast.Attribute) and
-            node.func.attr in _COLLECTIVES):
+    if not isinstance(node, ast.Call):
       return
-    dotted, _ = ctx.call_name(node)
-    if dotted and dotted.startswith(('numpy.', 'jax.lax.', 'jax.numpy.')):
+    dotted, term = ctx.call_name(node)
+    if isinstance(node.func, ast.Attribute):
+      if term not in _COLLECTIVES:
+        return
+    elif isinstance(node.func, ast.Name):
+      # A bare name is a collective only when alias resolution proves
+      # it (``from ..comm import barrier`` / ``sync = comm.barrier``):
+      # an unrelated local function that happens to be called
+      # ``barrier`` resolves to itself, dotless, and is not flagged.
+      if (not dotted or '.' not in dotted
+          or dotted.rsplit('.', 1)[-1] not in _COLLECTIVES):
+        return
+      term = dotted.rsplit('.', 1)[-1]
+    else:
+      return
+    if dotted and dotted.startswith(DEVICE_COLLECTIVE_PREFIXES):
       return  # array shape ops (e.g. lax.broadcast), not collectives
     for anc in ctx.ancestors:
       if isinstance(anc, (ast.If, ast.While, ast.IfExp)):
         ident = _rank_mention(anc.test)
         if ident:
           yield self.finding(
-              node, f'collective {node.func.attr}() inside a branch '
+              node, f'collective {term}() inside a branch '
               f'conditioned on {ident!r}: ranks disagreeing on the '
               'branch deadlock the collective', ctx)
           return
@@ -483,8 +482,169 @@ class SwallowedException(Rule):
         'observe the failure (telemetry/log/re-raise)', ctx)
 
 
+# ---------------------------------------------------------------------------
+# Project-mode (interprocedural) rules: LDA008–LDA011 run over the
+# whole-program call graph, not per file. Each finding carries the call
+# chain from the analysis root to the effect site.
+
+
+class TransitiveRankCollective(ProjectRule):
+  rule_id = 'LDA008'
+  name = 'transitive-rank-collective'
+  invariant = ('collectives are issued uniformly by every rank even '
+               'through call chains: a rank-conditional call whose '
+               'callee (transitively) performs a collective deadlocks '
+               'exactly like a lexical one — LDA005 one indirection out')
+  hint = ('hoist the call (or just its collective) out of the rank '
+          'conditional; keep only rank-local work inside it')
+
+  def check(self, index, graph):
+    for gq in sorted(index.defs):
+      facts = index.defs[gq]
+      targets = graph.call_targets.get(gq, ())
+      for call, tgt in zip(facts.calls, targets):
+        if not call.rank_cond or not tgt:
+          continue
+        if is_lexical_collective(call):
+          continue  # lexical case: LDA005's finding, not ours
+        if 'collective' not in graph.transitive_effects(tgt):
+          continue
+        sites = graph.reachable_effects(tgt, ('collective',))
+        if not sites:
+          continue
+        eff_gq, eff, hops = sites[0]
+        chain = ([{'name': f'{index.display(gq)}()',
+                   'path': index.def_path(gq), 'line': call.line}]
+                 + build_chain(index, hops, eff_gq, eff))
+        yield self.finding(
+            index.def_path(gq), call.line, call.col,
+            f'{call.terminal}() called under a branch conditioned on '
+            f'{call.rank_cond!r} transitively issues collective '
+            f'{eff.detail}(): ranks skipping the branch deadlock the '
+            'ones that entered it', chain=chain)
+
+
+class ElasticPathPurity(ProjectRule):
+  rule_id = 'LDA009'
+  name = 'elastic-path-purity'
+  invariant = ('the elastic scheduling path issues zero collectives and '
+               'never waits unboundedly: survivors must make progress '
+               'when a rank dies mid-phase, so nothing reachable from '
+               'the claim/heartbeat/re-execution machinery may block on '
+               'a peer')
+  hint = ('make phase completion an observable fact (manifests, lease '
+          'expiry) instead of a rendezvous; give every wait a timeout')
+
+  # Roots are matched by definition/class name so the rule holds for
+  # the real executor and for fixtures shaped like it.
+  ROOT_DEFS = ('Executor._map_elastic',)
+  ROOT_CLASSES = ('_LeaseClaimer', '_HeartbeatPump')
+
+  def _roots(self, index):
+    roots = []
+    for gq in sorted(index.defs):
+      if index.display(gq) in self.ROOT_DEFS:
+        roots.append(gq)
+        continue
+      cls = index.defs[gq].cls
+      if cls and cls.rsplit('.', 1)[-1] in self.ROOT_CLASSES:
+        roots.append(gq)
+    return roots
+
+  def check(self, index, graph):
+    seen = set()
+    for root in self._roots(index):
+      for eff_gq, eff, hops in graph.reachable_effects(
+          root, ('collective', 'unbounded_wait')):
+        key = (index.def_path(eff_gq), eff.line, eff.col, eff.detail)
+        if key in seen:
+          continue
+        seen.add(key)
+        what = ('collective ' + eff.detail + '()'
+                if eff.kind == 'collective'
+                else f'unbounded wait {eff.detail}')
+        yield self.finding(
+            index.def_path(eff_gq), eff.line, eff.col,
+            f'{what} reachable from elastic root '
+            f'{index.display(root)}(): a dead rank would hang the '
+            'survivors that are supposed to outlive it',
+            chain=build_chain(index, hops, eff_gq, eff))
+
+
+class JitHostSync(ProjectRule):
+  rule_id = 'LDA010'
+  name = 'jit-host-sync'
+  invariant = ('jit-compiled code stays on device: a host sync '
+               '(.item()/float()/np.asarray/device_get/'
+               'block_until_ready) or wall-clock read reachable from a '
+               'traced function forces a device flush at best and a '
+               'retrace or tracer error at worst, stalling every step')
+  hint = ('keep host-side reads outside the jitted function; pass '
+          'values in as arguments, return metrics as arrays and read '
+          'them after the step')
+
+  def check(self, index, graph):
+    roots = index.jit_root_defs()
+    seen = set()
+    for root in sorted(roots):
+      for eff_gq, eff, hops in graph.reachable_effects(
+          root, ('host_sync', 'wall_clock')):
+        key = (index.def_path(eff_gq), eff.line, eff.col, eff.detail)
+        if key in seen:
+          continue
+        seen.add(key)
+        yield self.finding(
+            index.def_path(eff_gq), eff.line, eff.col,
+            f'{eff.detail} ({eff.kind}) reachable from jit-compiled '
+            f'{index.display(root)}(): host synchronization inside '
+            'traced code stalls or retraces the step',
+            chain=build_chain(index, hops, eff_gq, eff))
+
+
+class CollectiveOrderDivergence(ProjectRule):
+  rule_id = 'LDA011'
+  name = 'collective-order-divergence'
+  invariant = ('every rank issues the same collectives in the same '
+               'order: two branch arms reaching different collective '
+               'sequences deadlock the fleet as soon as ranks disagree '
+               'on the (data-dependent) condition')
+  hint = ('restructure so both arms issue the identical collective '
+          'sequence (hoist the collectives out of the branch), or make '
+          'the condition provably rank-uniform')
+
+  def _arm_trace(self, graph, facts, targets, idxs):
+    out = []
+    for i in idxs:
+      call = facts.calls[i]
+      if is_lexical_collective(call):
+        out.append(call.terminal)
+      elif targets[i]:
+        out.extend(graph.collective_trace(targets[i]))
+      if len(out) >= 8:
+        return tuple(out[:8])
+    return tuple(out)
+
+  def check(self, index, graph):
+    for gq in sorted(index.defs):
+      facts = index.defs[gq]
+      targets = graph.call_targets.get(gq, ())
+      for branch in facts.branches:
+        if not branch.body or not branch.orelse:
+          continue
+        body = self._arm_trace(graph, facts, targets, branch.body)
+        orelse = self._arm_trace(graph, facts, targets, branch.orelse)
+        if not body or not orelse or body == orelse:
+          continue
+        yield self.finding(
+            index.def_path(gq), branch.line, 1,
+            f'branch arms in {index.display(gq)}() reach different '
+            f'collective sequences ({" → ".join(body)} vs '
+            f'{" → ".join(orelse)}): ranks disagreeing on the '
+            'condition issue mismatched collectives and deadlock')
+
+
 def default_rules():
-  """Fresh instances of every shipped rule, in rule-id order."""
+  """Fresh instances of every shipped per-file rule, in rule-id order."""
   return [
       UnsortedFsIteration(),
       GlobalStateRng(),
@@ -496,5 +656,20 @@ def default_rules():
   ]
 
 
+def project_rules():
+  """Fresh instances of every interprocedural (project-mode) rule."""
+  return [
+      TransitiveRankCollective(),
+      ElasticPathPurity(),
+      JitHostSync(),
+      CollectiveOrderDivergence(),
+  ]
+
+
+def all_rules():
+  """Per-file + project rules, in rule-id order."""
+  return default_rules() + project_rules()
+
+
 def rules_by_id():
-  return {r.rule_id: r for r in default_rules()}
+  return {r.rule_id: r for r in all_rules()}

@@ -131,6 +131,9 @@ class AsyncShardWriter:
 
   def flush(self):
     """Block until all submitted jobs ran; raise on any failure."""
+    # lddl: noqa[LDA009] rank-local drain of this process's own writer
+    # thread: every job's finally calls task_done(), so join() is bounded
+    # by the already-submitted writes — no cross-rank peer is waited on.
     self._q.join()
     self._raise_pending()
 
@@ -142,6 +145,9 @@ class AsyncShardWriter:
   def close(self, raise_errors=True):
     """Drain, stop the thread, and (optionally) raise pending failures."""
     self._q.put(None)
+    # lddl: noqa[LDA009] same rank-local writer-thread drain as flush():
+    # the sentinel just enqueued guarantees the loop exits after the
+    # backlog, and the thread join below carries a timeout.
     self._q.join()
     self._thread.join(timeout=30.0)
     if raise_errors:

@@ -1,0 +1,459 @@
+"""Project-mode (interprocedural) analyzer tests: LDA008–LDA011 over
+synthetic package trees, call-chain traces, SARIF code flows, and the
+byte-identity guarantee of the parallel per-file driver.
+
+Every fixture is a real on-disk package (``make_pkg``) because project
+mode resolves imports by walking ``__init__.py`` chains — in-memory
+sources can't exercise that.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from lddl_tpu.analysis import analyze_paths, analyze_project
+from lddl_tpu.analysis.cli import main as cli_main
+
+
+def make_pkg(tmp_path, files):
+  """Write ``files`` (relpath -> source) under ``tmp_path/proj`` and
+  drop an ``__init__.py`` in every directory so the tree imports as one
+  package. Returns the package root path."""
+  root = tmp_path / 'proj'
+  root.mkdir()
+  for rel, src in sorted(files.items()):
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+  dirs = {root} | {p.parent for p in root.rglob('*.py')}
+  for d in dirs:
+    init = d / '__init__.py'
+    if not init.exists():
+      init.write_text('')
+  return root
+
+
+def project_ids(root, rules=None):
+  findings, _ = analyze_project([str(root)], rules=rules)
+  return sorted({f.rule_id for f in findings if not f.suppressed})
+
+
+def project_findings(root, rules=None):
+  findings, _ = analyze_project([str(root)], rules=rules)
+  return findings
+
+
+# ---------------------------------------------------------------------------
+# LDA008: rank-conditional call that transitively reaches a collective
+
+
+_TWO_HOP = {
+    'report.py': """
+        def _publish(comm, payload):
+          comm.allgather_object(payload)
+
+        def _report(comm, payload):
+          _publish(comm, payload)
+        """,
+    'main.py': """
+        from .report import _report
+
+        def run(comm, rank, payload):
+          if rank == 0:
+            _report(comm, payload)
+        """,
+}
+
+
+def test_lda008_two_hops_where_lda005_is_blind(tmp_path):
+  """The acceptance case: the collective sits two calls away from the
+  rank branch. The lexical rule (LDA005) provably cannot see it; the
+  call-graph rule must."""
+  root = make_pkg(tmp_path, _TWO_HOP)
+  ids = project_ids(root)
+  assert 'LDA008' in ids
+  assert 'LDA005' not in ids
+
+
+def test_lda008_chain_names_the_full_path(tmp_path):
+  root = make_pkg(tmp_path, _TWO_HOP)
+  found = [f for f in project_findings(root) if f.rule_id == 'LDA008']
+  assert len(found) == 1
+  f = found[0]
+  assert f.path.endswith('main.py')
+  names = [hop['name'] for hop in f.chain]
+  assert names == ['run()', '_report()', '_publish()', 'allgather_object']
+  # last hop pins the effect site in report.py
+  assert f.chain[-1]['path'].endswith('report.py')
+  assert 'via:' in f.render()
+
+
+def test_lda008_three_hop_indirection(tmp_path):
+  root = make_pkg(tmp_path, {
+      'deep.py': """
+          def _c(comm):
+            comm.barrier()
+
+          def _b(comm):
+            _c(comm)
+
+          def _a(comm):
+            _b(comm)
+          """,
+      'entry.py': """
+          from .deep import _a
+
+          def run(comm, rank):
+            if rank == 0:
+              _a(comm)
+          """,
+  })
+  found = [f for f in project_findings(root) if f.rule_id == 'LDA008']
+  assert len(found) == 1
+  names = [hop['name'] for hop in found[0].chain]
+  assert names == ['run()', '_a()', '_b()', '_c()', 'barrier']
+
+
+def test_lda008_method_call_through_local_ctor(tmp_path):
+  root = make_pkg(tmp_path, {
+      'pub.py': """
+          class Publisher:
+            def publish(self, comm):
+              comm.barrier()
+          """,
+      'use.py': """
+          from .pub import Publisher
+
+          def go(comm, rank):
+            p = Publisher()
+            if rank == 0:
+              p.publish(comm)
+          """,
+  })
+  ids = project_ids(root)
+  assert 'LDA008' in ids
+
+
+def test_lda008_uniform_call_is_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'report.py': _TWO_HOP['report.py'],
+      'main.py': """
+          from .report import _report
+
+          def run(comm, payload):
+            _report(comm, payload)
+          """,
+  })
+  assert 'LDA008' not in project_ids(root)
+
+
+def test_lda008_pragma_suppresses(tmp_path):
+  root = make_pkg(tmp_path, {
+      'report.py': _TWO_HOP['report.py'],
+      'main.py': """
+          from .report import _report
+
+          def run(comm, rank, payload):
+            if rank == 0:
+              # all ranks re-enter via the retry loop  # lddl: noqa[LDA008]
+              _report(comm, payload)
+          """,
+  })
+  findings = [f for f in project_findings(root) if f.rule_id == 'LDA008']
+  assert findings and all(f.suppressed for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# LDA009: elastic-path purity
+
+
+def test_lda009_collective_reachable_from_map_elastic(tmp_path):
+  root = make_pkg(tmp_path, {
+      'exec.py': """
+          class Executor:
+            def _map_elastic(self, comm):
+              self._sync(comm)
+
+            def _sync(self, comm):
+              comm.barrier()
+          """,
+  })
+  found = [f for f in project_findings(root) if f.rule_id == 'LDA009']
+  assert len(found) == 1
+  assert 'barrier' in found[0].message
+  names = [hop['name'] for hop in found[0].chain]
+  assert names[0] == 'Executor._map_elastic()'
+
+
+def test_lda009_unbounded_wait_in_lease_claimer(tmp_path):
+  root = make_pkg(tmp_path, {
+      'lease.py': """
+          class _LeaseClaimer:
+            def poll(self, q):
+              return q.get()
+          """,
+  })
+  found = [f for f in project_findings(root) if f.rule_id == 'LDA009']
+  assert len(found) == 1
+  assert 'unbounded wait' in found[0].message
+
+
+def test_lda009_bounded_waits_and_str_join_are_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'pump.py': """
+          class _HeartbeatPump:
+            def poll(self, q, parts):
+              item = q.get(timeout=1.0)
+              label = ', '.join(parts)
+              return item, label
+          """,
+  })
+  assert 'LDA009' not in project_ids(root)
+
+
+def test_lda009_pragma_suppresses(tmp_path):
+  root = make_pkg(tmp_path, {
+      'lease.py': """
+          class _LeaseClaimer:
+            def poll(self, q):
+              # rank-local queue, producer owned by this process  # lddl: noqa[LDA009]
+              return q.get()
+          """,
+  })
+  findings = [f for f in project_findings(root) if f.rule_id == 'LDA009']
+  assert findings and all(f.suppressed for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# LDA010: host sync / wall clock reachable from jit-compiled code
+
+
+def test_lda010_decorated_jit_root(tmp_path):
+  root = make_pkg(tmp_path, {
+      'step.py': """
+          import functools
+          import jax
+
+          @jax.jit
+          def step(x):
+            return _log(x)
+
+          def _log(x):
+            return float(x)
+          """,
+  })
+  found = [f for f in project_findings(root) if f.rule_id == 'LDA010']
+  assert len(found) == 1
+  assert 'float()' in found[0].message
+  names = [hop['name'] for hop in found[0].chain]
+  assert names[0] == 'step()'
+  assert names[-1] == 'float()'
+
+
+def test_lda010_wrapped_assignment_root(tmp_path):
+  root = make_pkg(tmp_path, {
+      'poll.py': """
+          import time
+
+          import jax
+
+          def _poll(x):
+            t = time.monotonic()
+            return x, t
+
+          step_fn = jax.jit(_poll)
+          """,
+  })
+  found = [f for f in project_findings(root) if f.rule_id == 'LDA010']
+  assert len(found) == 1
+  assert 'wall_clock' in found[0].message
+
+
+def test_lda010_compiled_step_cache_root(tmp_path):
+  root = make_pkg(tmp_path, {
+      'cache.py': """
+          from .runner import CompiledStepCache
+
+          def _step(batch):
+            return batch.stats.item()
+
+          cached = CompiledStepCache(_step)
+          """,
+      'runner.py': """
+          class CompiledStepCache:
+            def __init__(self, fn):
+              self.fn = fn
+          """,
+  })
+  found = [f for f in project_findings(root) if f.rule_id == 'LDA010']
+  assert len(found) == 1
+  assert 'host_sync' in found[0].message
+
+
+def test_lda010_pure_device_code_is_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'pure.py': """
+          import jax
+          import jax.numpy as jnp
+
+          @jax.jit
+          def step(x):
+            return jnp.sum(x) * 2
+          """,
+  })
+  assert 'LDA010' not in project_ids(root)
+
+
+def test_lda010_pragma_suppresses(tmp_path):
+  root = make_pkg(tmp_path, {
+      'step.py': """
+          import jax
+
+          @jax.jit
+          def step(x):
+            return _log(x)
+
+          def _log(x):
+            # debug-only scalar read, stripped in real runs  # lddl: noqa[LDA010]
+            return float(x)
+          """,
+  })
+  findings = [f for f in project_findings(root) if f.rule_id == 'LDA010']
+  assert findings and all(f.suppressed for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# LDA011: collective-order divergence between branch arms
+
+
+def test_lda011_arms_reach_different_orders(tmp_path):
+  root = make_pkg(tmp_path, {
+      'order.py': """
+          def _fast(comm, x):
+            comm.allreduce_sum(x)
+            comm.barrier()
+
+          def _slow(comm, x):
+            comm.barrier()
+            comm.allreduce_sum(x)
+
+          def run(comm, small, x):
+            if small:
+              _fast(comm, x)
+            else:
+              _slow(comm, x)
+          """,
+  })
+  found = [f for f in project_findings(root) if f.rule_id == 'LDA011']
+  assert len(found) == 1
+  assert 'allreduce_sum' in found[0].message
+  assert 'barrier' in found[0].message
+
+
+def test_lda011_same_order_is_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'order.py': """
+          def _a(comm, x):
+            comm.barrier()
+
+          def _b(comm, x):
+            comm.barrier()
+
+          def run(comm, small, x):
+            if small:
+              _a(comm, x)
+            else:
+              _b(comm, x)
+          """,
+  })
+  assert 'LDA011' not in project_ids(root)
+
+
+def test_lda011_single_armed_branch_is_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'order.py': """
+          def run(comm, small, x):
+            if small:
+              comm.barrier()
+          """,
+  })
+  assert 'LDA011' not in project_ids(root)
+
+
+# ---------------------------------------------------------------------------
+# Chain serialization: JSON schema v2 and SARIF code flows
+
+
+def test_cli_json_chain_snapshot(tmp_path, capsys, monkeypatch):
+  root = make_pkg(tmp_path, _TWO_HOP)
+  monkeypatch.chdir(tmp_path)
+  assert cli_main(['--format', 'json', str(root)]) == 1
+  doc = json.loads(capsys.readouterr().out)
+  assert doc['version'] == 2
+  assert doc['mode'] == 'project'
+  chained = [f for f in doc['findings'] if f['rule'] == 'LDA008']
+  assert len(chained) == 1
+  chain = chained[0]['chain']
+  assert [hop['name'] for hop in chain] == [
+      'run()', '_report()', '_publish()', 'allgather_object']
+  for hop in chain:
+    assert set(hop) == {'name', 'path', 'line'}
+    assert isinstance(hop['line'], int) and hop['line'] > 0
+  # per-file findings in the same document carry chain: null
+  assert all('chain' in f for f in doc['findings'])
+
+
+def test_cli_sarif_code_flow(tmp_path, capsys):
+  root = make_pkg(tmp_path, _TWO_HOP)
+  assert cli_main(['--format', 'sarif', str(root)]) == 1
+  doc = json.loads(capsys.readouterr().out)
+  results = doc['runs'][0]['results']
+  chained = [r for r in results if r['ruleId'] == 'LDA008']
+  assert len(chained) == 1
+  flows = chained[0]['codeFlows']
+  locs = flows[0]['threadFlows'][0]['locations']
+  assert len(locs) == 4  # run -> _report -> _publish -> allgather_object
+  messages = [l['location']['message']['text'] for l in locs]
+  assert messages[0] == 'run()'
+  assert messages[-1] == 'allgather_object'
+
+
+# ---------------------------------------------------------------------------
+# Determinism: parallel driver byte-identity, repeated-run identity
+
+
+def _many_files(tmp_path, n=10):
+  files = {}
+  for i in range(n):
+    files[f'm{i:02d}.py'] = f"""
+        import os
+
+        def scan_{i}(root):
+          return os.listdir(root)
+        """
+  return make_pkg(tmp_path, files)
+
+
+def test_parallel_file_pass_is_byte_identical(tmp_path):
+  root = _many_files(tmp_path)
+  serial, n1 = analyze_paths([str(root)], jobs=1)
+  parallel, n2 = analyze_paths([str(root)], jobs=4)
+  assert n1 == n2 == 11  # 10 modules + __init__.py
+  assert [f.render() for f in serial] == [f.render() for f in parallel]
+  assert len(serial) == 10  # one LDA001 per module
+
+
+def test_project_runs_are_byte_identical(tmp_path):
+  root = make_pkg(tmp_path, _TWO_HOP)
+  first = [f.render() for f in project_findings(root)]
+  second = [f.render() for f in project_findings(root)]
+  assert first == second
+
+
+def test_rule_subset_runs_only_project_rule(tmp_path):
+  from lddl_tpu.analysis.rules import TransitiveRankCollective
+  root = make_pkg(tmp_path, _TWO_HOP)
+  findings = project_findings(root, rules=[TransitiveRankCollective()])
+  assert findings
+  assert {f.rule_id for f in findings} == {'LDA008'}
