@@ -251,17 +251,15 @@ def run_scan(args, loader, tokenizer):
   flops_per_step = bert_pretrain_flops_per_step(
       cfg, b, s, max_predictions=args.max_predictions)
   times = []
-  if args.profile_dir:
-    jax.profiler.start_trace(args.profile_dir)
-  try:
+  # Shared capture path with the live /profile endpoint (same output
+  # layout); no-op when --profile-dir is unset.
+  from lddl_tpu.telemetry.profiling import trace_capture
+  with trace_capture(args.profile_dir):
     for _ in range(args.scan_windows):
       t0 = time.perf_counter()
       params, opt_state, metrics = scan(params, opt_state, rng, window)
       loss = float(metrics['loss'])
       times.append(time.perf_counter() - t0)
-  finally:
-    if args.profile_dir:
-      jax.profiler.stop_trace()
   # Median window: robust against tunnel-jitter outliers in either
   # direction (slow links stall; a too-fast sample means a sync anomaly).
   med_step = sorted(times)[len(times) // 2] / k

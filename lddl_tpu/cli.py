@@ -24,6 +24,9 @@ fork's CodeBERT wrapper), all thin delegates:
                                     dashboard over LDDL_MONITOR
                                     endpoints: rates, verdict,
                                     stragglers, goodput)
+  lddl_perf                      -> lddl_tpu.telemetry.perf (robust
+                                    perf-regression gate over bench
+                                    history; --gate for CI)
 
 Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
 installed console scripts.
@@ -112,6 +115,11 @@ def lddl_monitor(args=None):
   return main(args)
 
 
+def lddl_perf(args=None):
+  from .telemetry.perf import main
+  return main(args)
+
+
 _COMMANDS = {
     'download_wikipedia': download_wikipedia,
     'download_books': download_books,
@@ -134,6 +142,8 @@ _COMMANDS = {
     'lddl-analyze': lddl_analyze,  # dash-form alias
     'lddl_monitor': lddl_monitor,
     'lddl-monitor': lddl_monitor,  # dash-form alias
+    'lddl_perf': lddl_perf,
+    'lddl-perf': lddl_perf,  # dash-form alias
 }
 
 

@@ -131,10 +131,42 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
     return False
 
   tracer = get_tracer()
+  tele = get_telemetry()
   # Histogram twin of the train.h2d trace span: the live overlap meter
   # needs h2d totals in the metrics registry (1 - data_wait/h2d), and
   # spans only land in the trace ring. Handle fetched once per prefetch.
-  h2d_hist = get_telemetry().histogram('train.h2d_seconds')
+  h2d_hist = tele.histogram('train.h2d_seconds')
+  # Live-array accounting: bytes/batches this prefetcher currently holds
+  # on device — the measured form of the donation contract's
+  # "steady-state HBM = in-flight transfer + batch being consumed"
+  # claim. Producer thread adds at placement, consumer subtracts at
+  # donation delete, so a watcher scraping the gauge sees the claim hold
+  # (or not) in real time. Zero-cost when telemetry is off.
+  live_bytes_g = tele.gauge('loader.device_live_bytes')
+  live_batches_g = tele.gauge('loader.device_live_batches')
+  live_sizes = {}  # id(placed batch) -> device bytes
+  live_lock = threading.Lock()
+
+  def _device_nbytes(item):
+    if isinstance(item, (list, tuple)):
+      return sum(_device_nbytes(x) for x in item)
+    if isinstance(item, dict):
+      return sum(_device_nbytes(v) for v in item.values())
+    # Addressable shards = what actually sits in this process's HBM (a
+    # multi-host global array's .nbytes would count remote shards too).
+    shards = getattr(item, 'addressable_shards', None)
+    if shards:
+      return sum(int(s.data.nbytes) for s in shards)
+    return int(getattr(item, 'nbytes', 0) or 0)
+
+  def _track(placed, sign):
+    with live_lock:
+      if sign > 0:
+        live_sizes[id(placed)] = _device_nbytes(placed)
+      else:
+        live_sizes.pop(id(placed), None)
+      live_bytes_g.set(sum(live_sizes.values()))
+      live_batches_g.set(len(live_sizes))
 
   def _producer():
     try:
@@ -143,6 +175,8 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
         # own trace lane (overlaps the main thread's compute span).
         with tracer.span('train.h2d'), h2d_hist.time():
           placed = _put(item)
+        if tele.enabled:
+          _track(placed, +1)
         if not _blocking_put(placed):
           return
     except BaseException as e:  # propagate into the consumer
@@ -166,12 +200,20 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
         # defers to XLA usage holds, so a still-executing step that read
         # this batch finishes before the memory is actually freed.
         _delete_device_batch(item)
+        if tele.enabled:
+          _track(item, -1)
   finally:
     stop.set()
     # Serialize with the producer: after close() returns, the source
     # iterator is guaranteed quiescent (it may be mid-pull right now, e.g.
     # finishing an epoch and mutating loader state).
     t.join()
+    if tele.enabled and live_sizes:
+      # The stream is closed and the producer joined: whatever we still
+      # tracked is dead (yielded refs are dropped with the generator).
+      live_sizes.clear()
+      live_bytes_g.set(0)
+      live_batches_g.set(0)
 
 
 def _delete_device_batch(item):

@@ -4,7 +4,7 @@ The reference's mock training harness reports samples/s and latency only
 (``/root/reference/benchmarks/torch_train.py:188-199``); on TPU the number
 that actually tells you whether the input pipeline keeps the MXU busy is
 **model FLOPs utilization** = model FLOPs per second / peak chip FLOPs.
-This module provides the two ingredients:
+This module provides the ingredients:
 
   - :func:`bert_pretrain_flops_per_step` — analytic matmul FLOPs of one
     BERT MLM+NSP train step over a padded ``[batch, seq]`` batch (standard
@@ -12,13 +12,19 @@ This module provides the two ingredients:
     head 2·B·S·d·(d+V), backward = 2× forward);
   - :func:`peak_flops_per_device` — best-known bf16 peak for the running
     chip generation (override with the harness's ``--peak-tflops`` when
-    the table is stale or the platform is unknown).
+    the table is stale or the platform is unknown);
+  - :func:`peak_hbm_bytes_per_device` / :func:`machine_balance` — the
+    memory axis of the roofline: published HBM bandwidth per chip, and
+    the FLOPs/byte ridge point that separates compute-bound from
+    memory-bound (arXiv:2104.08335 shows this workload crosses it as
+    sequence length and batch shape vary).
 """
 
 import jax
 
-# Published bf16 peak TFLOP/s per chip, keyed by a lowercase substring of
-# jax's device_kind. Order matters: first match wins.
+# Published bf16 peak TFLOP/s and HBM bandwidth (GB/s) per chip, keyed by
+# a lowercase substring of jax's device_kind. Order matters: first match
+# wins.
 _PEAK_TFLOPS_BF16 = (
     ('v6e', 918.0),
     ('trillium', 918.0),
@@ -33,21 +39,61 @@ _PEAK_TFLOPS_BF16 = (
     ('v2', 45.0),
 )
 
+_PEAK_HBM_GBPS = (
+    ('v6e', 1640.0),
+    ('trillium', 1640.0),
+    ('v5p', 2765.0),
+    ('v5 lite', 819.0),
+    ('v5e', 819.0),
+    # Same ordering constraint as the FLOPs table: the lite/v5e keys must
+    # win before the plain-'v5' (= v5p) fallback.
+    ('v5', 2765.0),
+    ('v4', 1228.0),
+    ('v3', 900.0),
+    ('v2', 700.0),
+)
+
+
+def _lookup_peak(table, device, scale, what, flag):
+  device = device or jax.devices()[0]
+  kind = device.device_kind.lower()
+  for key, peak in table:
+    if key in kind:
+      return peak * scale
+  if 'tpu' in kind:
+    import warnings
+    warnings.warn(
+        f'no peak-{what} entry for device_kind {device.device_kind!r}; '
+        f'the roofline {what} axis will be omitted — set {flag} to '
+        'report it')
+  return None
+
 
 def peak_flops_per_device(device=None):
   """Peak bf16 FLOP/s of ``device`` (default: jax.devices()[0]), or None
   when the chip generation is not in the table (e.g. the CPU backend)."""
-  device = device or jax.devices()[0]
-  kind = device.device_kind.lower()
-  for key, tflops in _PEAK_TFLOPS_BF16:
-    if key in kind:
-      return tflops * 1e12
-  if 'tpu' in kind:
-    import warnings
-    warnings.warn(
-        f'no peak-FLOPs entry for device_kind {device.device_kind!r}; '
-        'MFU will be omitted — pass --peak-tflops to report it')
-  return None
+  return _lookup_peak(_PEAK_TFLOPS_BF16, device, 1e12, 'FLOPs',
+                      'LDDL_PEAK_TFLOPS')
+
+
+def peak_hbm_bytes_per_device(device=None):
+  """Peak HBM bandwidth (bytes/s) of ``device``, or None when the chip
+  generation is not in the table (override with ``LDDL_PEAK_HBM_GBPS``,
+  in GB/s per device)."""
+  return _lookup_peak(_PEAK_HBM_GBPS, device, 1e9, 'HBM-bandwidth',
+                      'LDDL_PEAK_HBM_GBPS')
+
+
+def machine_balance(device=None):
+  """The roofline ridge point of ``device`` in FLOPs/byte (peak FLOP/s ÷
+  peak HBM bytes/s): kernels whose arithmetic intensity exceeds this are
+  compute-bound, below it memory-bound. None when either peak is
+  unknown."""
+  flops = peak_flops_per_device(device)
+  bw = peak_hbm_bytes_per_device(device)
+  if not flops or not bw:
+    return None
+  return flops / bw
 
 
 def bert_encoder_flops(cfg, batch, seq_len):

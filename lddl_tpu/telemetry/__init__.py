@@ -28,6 +28,15 @@ per-process HTTP endpoint (JSON ``/snapshot`` + Prometheus
 ``/metrics``) that ``python -m lddl_tpu.cli lddl-monitor`` turns into a
 refreshing terminal dashboard. Same no-op discipline: unset means zero
 threads, zero sockets.
+
+The device-side plane (:mod:`.roofline` + :mod:`.profiling` +
+:mod:`.perf`) closes the loop against the chip itself: exact per-step
+FLOPs/bytes from ``compiled.cost_analysis()`` feeding a windowed
+roofline verdict (compute- vs memory- vs input-bound) and the measured
+MFU numerator, ``device.memory_stats()`` HBM gauges at the scrape
+cadence, on-demand ``jax.profiler`` capture armed over the monitor's
+``/profile`` endpoint, and the ``lddl-perf`` regression gate over bench
+history.
 """
 
 from .metrics import (
@@ -64,6 +73,17 @@ from .report import (
     load_rank_files,
     merge_metric_lines,
     render_report,
+)
+from .roofline import (
+    compiled_step_costs,
+    resolve_peaks,
+    roofline_verdict,
+    sample_hbm,
+)
+from .profiling import (
+    StepProfiler,
+    get_step_profiler,
+    trace_capture,
 )
 from .trace import (
     NOOP_TRACER,

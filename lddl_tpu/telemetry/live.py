@@ -119,16 +119,21 @@ def stage_rates(window):
 def live_verdict(window):
   """The post-hoc bottleneck verdict, computed over the live window.
 
-  Returns ``summarize_stages``' dict plus ``window_sec``; falls back to
+  Returns ``summarize_stages``' dict plus ``window_sec`` and — when the
+  train loop's compiled-step cache is feeding XLA cost counters — a
+  ``roofline`` sub-verdict (achieved vs peak FLOP/s and bytes/s,
+  arithmetic intensity vs machine balance, bound class). Falls back to
   ``{'bottleneck': 'unknown (window warming up)'}`` until the window
   holds two samples.
   """
   merged, sec = _merged_delta(window)
   if merged is None:
     return {'stages': {}, 'bottleneck': 'unknown (window warming up)',
-            'detail': '', 'window_sec': 0.0}
+            'detail': '', 'window_sec': 0.0, 'roofline': None}
   verdict = summarize_stages(merged)
   verdict['window_sec'] = sec
+  from .roofline import roofline_verdict
+  verdict['roofline'] = roofline_verdict(merged, sec)
   return verdict
 
 
@@ -221,6 +226,20 @@ def goodput_meters(merged):
   out['queue_depth'] = _gauge(metrics, 'loader.queue_depth')
   out['shm_slot_occupancy'] = _gauge(metrics, 'loader.shm_slot_occupancy')
   out['writer_backlog'] = _gauge(metrics, 'pipeline.pool.writer_backlog')
+
+  out['mfu'] = _gauge(metrics, 'train.mfu')
+  # Device-memory meters: the prefetcher's live-array accounting (the
+  # measured form of the "steady-state HBM = 2 batches" donation claim)
+  # and the allocator's own view sampled from device.memory_stats().
+  out['device_live_bytes'] = _gauge(metrics, 'loader.device_live_bytes')
+  out['device_live_batches'] = _gauge(metrics, 'loader.device_live_batches')
+  hbm = {
+      'bytes_in_use': _gauge(metrics, 'hbm.bytes_in_use'),
+      'peak_bytes_in_use': _gauge(metrics, 'hbm.peak_bytes_in_use'),
+      'bytes_limit': _gauge(metrics, 'hbm.bytes_limit'),
+      'headroom_frac': _gauge(metrics, 'hbm.headroom_frac'),
+  }
+  out['hbm'] = hbm if any(v is not None for v in hbm.values()) else None
 
   # Fault-tolerance meters: lease churn of the elastic executor plus the
   # local recovery counters (pool respawns, retried comm IO). All-zero
@@ -372,10 +391,15 @@ def live_status(window, rank=0, telemetry=None, include_metrics=True):
   Samples the registry into ``window`` first (the poller's cadence IS
   the window cadence), then derives rates/verdict/goodput from the
   windowed delta and this rank's straggler signals from the same
-  window. ``include_metrics=False`` drops the full cumulative dump for
+  window. HBM gauges are refreshed from ``device.memory_stats()``
+  immediately before the capture, so device-memory telemetry runs at
+  exactly the scrape cadence — an unwatched process never polls the
+  device. ``include_metrics=False`` drops the full cumulative dump for
   lightweight dashboards.
   """
+  from .roofline import sample_hbm
   tele = telemetry if telemetry is not None else get_telemetry()
+  hbm = sample_hbm(tele)
   lines = window.sample(telemetry=tele, rank=rank)
   status = {
       'rank': rank,
@@ -388,6 +412,7 @@ def live_status(window, rank=0, telemetry=None, include_metrics=True):
       'verdict': live_verdict(window),
       'signals': rank_signals(window),
   }
+  status['hbm'] = hbm
   merged_cum = merge_metric_lines([lines]) if lines else {'metrics': {}}
   status['goodput'] = goodput_meters(merged_cum)
   if include_metrics:

@@ -41,28 +41,54 @@ class _UnixHTTPConnection(http.client.HTTPConnection):
     self.sock = sock
 
 
-def fetch_snapshot(url, timeout=5.0):
-  """GET ``<url>/snapshot`` -> parsed JSON dict. ``url`` is either an
-  ``http://host:port`` endpoint or ``unix:/path/to.sock``."""
+def _fetch_json(url, request_path, timeout=5.0):
+  """GET ``<url><request_path>`` -> parsed JSON dict. ``url`` is either
+  an ``http://host:port`` endpoint or ``unix:/path/to.sock``."""
   if url.startswith('unix:'):
     conn = _UnixHTTPConnection(url[len('unix:'):], timeout)
     try:
-      conn.request('GET', '/snapshot')
+      conn.request('GET', request_path)
       resp = conn.getresponse()
       if resp.status != 200:
-        raise RuntimeError(f'{url}/snapshot -> HTTP {resp.status}')
+        raise RuntimeError(f'{url}{request_path} -> HTTP {resp.status}')
       return json.loads(resp.read().decode('utf-8'))
     finally:
       conn.close()
-  with urllib.request.urlopen(url.rstrip('/') + '/snapshot',
+  with urllib.request.urlopen(url.rstrip('/') + request_path,
                               timeout=timeout) as resp:
     return json.loads(resp.read().decode('utf-8'))
 
 
-def discover_endpoints(directory):
-  """Endpoint URLs from announce files under ``directory``, rank order."""
+def fetch_snapshot(url, timeout=5.0):
+  """GET ``<url>/snapshot`` -> parsed JSON dict."""
+  return _fetch_json(url, '/snapshot', timeout=timeout)
+
+
+def fetch_profile(url, steps, timeout=5.0):
+  """Arm the endpoint's profiler: GET ``<url>/profile?steps=N``."""
+  return _fetch_json(url, f'/profile?steps={int(steps)}', timeout=timeout)
+
+
+def _announced_dead(info):
+  """True when an announce file names a pid we can *prove* died (same
+  pid namespace + positive /proc probe — the comm beacons' discipline).
+  Old-format announces without the identity fields are never flagged."""
+  pid = info.get('pid')
+  pidns = info.get('pidns')
+  if not isinstance(pid, int) or not pidns:
+    return False
+  from ..comm.backend import FileBackend
+  ours = FileBackend._pid_namespace()
+  if not ours or pidns != ours:
+    return False
+  return FileBackend._pid_dead(pid, info.get('pid_starttime') or '')
+
+
+def discover_announcements(directory):
+  """Parsed announce files under ``directory`` (rank order), each with a
+  ``dead`` flag from the pid probe."""
   paths = sorted(glob.glob(os.path.join(directory, 'monitor.rank*.json')))
-  urls = []
+  out = []
   for p in paths:
     try:
       with open(p) as f:
@@ -70,8 +96,21 @@ def discover_endpoints(directory):
     except (OSError, ValueError):
       continue  # being rewritten or already torn down; next poll catches up
     if info.get('url'):
-      urls.append(info['url'])
-  return urls
+      info['dead'] = _announced_dead(info)
+      out.append(info)
+  return out
+
+
+def discover_endpoints(directory, include_dead=False):
+  """Endpoint URLs from announce files under ``directory``, rank order.
+
+  A SIGKILLed rank cannot remove its announce file; its pid probe proves
+  it dead, and the stale endpoint is skipped (flagged upstream by
+  :func:`discover_announcements`) instead of being polled into a
+  timeout.
+  """
+  return [info['url'] for info in discover_announcements(directory)
+          if include_dead or not info['dead']]
 
 
 def poll_fleet(urls, timeout=5.0):
@@ -130,6 +169,35 @@ def render_frame(fleet, clear=True):
     out.append(f'  verdict: {verdict.get("bottleneck", "unknown")}')
     if verdict.get('detail'):
       out.append(f'    {verdict["detail"]}')
+    roof = verdict.get('roofline') or {}
+    bound = roof.get('bound')
+    if bound and not str(bound).startswith('unknown'):
+      line = f'  roofline: {bound}'
+      if roof.get('flops_per_sec'):
+        line += f' · {roof["flops_per_sec"] / 1e12:.2f} TFLOP/s'
+        if roof.get('flops_frac') is not None:
+          line += f' ({roof["flops_frac"]:.1%} of peak)'
+      if roof.get('bytes_per_sec'):
+        line += f' · {roof["bytes_per_sec"] / 1e9:.1f} GB/s'
+        if roof.get('bw_frac') is not None:
+          line += f' ({roof["bw_frac"]:.1%} of peak)'
+      if roof.get('arithmetic_intensity') is not None and \
+          roof.get('machine_balance') is not None:
+        line += (f' · AI {roof["arithmetic_intensity"]:.0f} vs balance '
+                 f'{roof["machine_balance"]:.0f} FLOPs/byte')
+      out.append(line)
+      if roof.get('detail'):
+        out.append(f'    {roof["detail"]}')
+    hbm = snap.get('hbm')
+    if hbm:
+      line = (f'  hbm: {hbm.get("bytes_in_use", 0) / 2**30:.2f} GiB in '
+              f'use · peak {hbm.get("peak_bytes_in_use", 0) / 2**30:.2f} '
+              'GiB')
+      if hbm.get('bytes_limit'):
+        line += f' · limit {hbm["bytes_limit"] / 2**30:.2f} GiB'
+      if hbm.get('headroom_frac') is not None:
+        line += f' · headroom {hbm["headroom_frac"]:.1%}'
+      out.append(line)
     rates = snap.get('rates', {})
     shown = sorted(n for n in rates if not n.endswith('.mean'))[:12]
     for name in shown:
@@ -145,6 +213,11 @@ def render_frame(fleet, clear=True):
       meters.append(f'h2d-overlap {good["h2d_overlap_fraction"]:.1%}')
     if good.get('attn_tile_skip_fraction') is not None:
       meters.append(f'attn-tiles-skipped {good["attn_tile_skip_fraction"]:.1%}')
+    if good.get('mfu'):
+      meters.append(f'mfu {good["mfu"]["mean"]:.1%}')
+    if good.get('device_live_batches'):
+      meters.append(f'device-live {good["device_live_batches"]["mean"]:.1f}'
+                    ' batches')
     for g in ('queue_depth', 'shm_slot_occupancy'):
       if good.get(g):
         meters.append(f'{g} {good[g]["mean"]:.1f}')
@@ -180,6 +253,9 @@ def attach_args(parser):
   parser.add_argument('--json', action='store_true',
                       help='with --once: emit the merged fleet payload '
                            'as JSON instead of the dashboard')
+  parser.add_argument('--profile', type=int, default=None, metavar='STEPS',
+                      help='arm every live endpoint\'s jax.profiler for '
+                           'the next STEPS train steps and exit')
   return parser
 
 
@@ -193,18 +269,50 @@ def main(args=None):
     return 2
 
   def _endpoints():
+    """(live urls, {stale url: why}) — explicit --url endpoints are
+    trusted; discovered ones are pid-probed and provably-dead announcers
+    are reported instead of polled into a timeout."""
     urls = list(args.url)
+    dead = {}
     if args.dir:
-      urls.extend(u for u in discover_endpoints(args.dir) if u not in urls)
-    return urls
+      for info in discover_announcements(args.dir):
+        if info['dead']:
+          dead[info['url']] = (f'announcer pid {info.get("pid")} is dead '
+                               '(stale announce file); skipped')
+        elif info['url'] not in urls:
+          urls.append(info['url'])
+    return urls, dead
+
+  if args.profile is not None:
+    if args.profile < 1:
+      print('lddl-monitor: --profile wants a positive step count',
+            file=sys.stderr)
+      return 2
+    urls, dead = _endpoints()
+    for url, why in sorted(dead.items()):
+      print(f'lddl-monitor: {url}: {why}', file=sys.stderr)
+    if not urls:
+      print('lddl-monitor: no live endpoints to profile', file=sys.stderr)
+      return 2
+    rc = 0
+    for url in urls:
+      try:
+        resp = fetch_profile(url, args.profile, timeout=args.timeout)
+        print(f'{url}: armed {resp.get("armed_steps")} step(s) -> '
+              f'{resp.get("trace_dir")}')
+      except (OSError, RuntimeError, ValueError) as e:
+        print(f'{url}: {e}', file=sys.stderr)
+        rc = 1
+    return rc
 
   while True:
-    urls = _endpoints()
-    if not urls:
+    urls, dead = _endpoints()
+    if not urls and not dead:
       print(f'lddl-monitor: no endpoints found '
             f'(no monitor.rank*.json in {args.dir})', file=sys.stderr)
       return 2
     fleet = poll_fleet(urls, timeout=args.timeout)
+    fleet['errors'].update(dead)
     if args.once:
       if args.json:
         print(json.dumps(fleet, default=str, indent=2))

@@ -1,0 +1,267 @@
+"""``lddl-perf``: robust perf-regression detection over bench history.
+
+The repo records a perf trajectory nothing reads: per-round
+``BENCH_r*.json`` (one throughput number each), ``MULTICHIP_r*.json``
+(multi-device smoke pass/fail), and — new in this PR — a bench-history
+JSONL that ``bench.py`` appends every run. This module turns that
+history into a CI gate: for each metric series it asks whether the
+*latest* point is a cliff relative to the prior points, using
+median ± MAD robust statistics (a cliff in the history must not poison
+the baseline that judges it, and real trajectories are noisy — the
+recorded rounds swing 0.8 → 16 MB/s/chip as PRs land, which any
+mean ± stddev test would misread).
+
+Decision rule, per series (latest point x, baseline = prior points):
+
+  scale = max(1.4826 * MAD, min_rel_drop * |median|)
+  regression iff  (median - x) * direction > 0            (got worse)
+             and |x - median| / scale > threshold          (far outside
+                                                            usual noise)
+             and |x - median| / |median| > min_rel_drop    (and by a
+                                                            margin anyone
+                                                            cares about)
+
+``direction`` is inferred from the metric name (latency/seconds/ms →
+lower-is-better; everything else higher-is-better). The MAD floor keeps
+a near-constant series (MAD ≈ 0) from flagging measurement jitter.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+DEFAULT_THRESHOLD = 4.0
+DEFAULT_MIN_REL_DROP = 0.05
+MIN_POINTS = 4
+
+_HIGHER_IS_BETTER_HINTS = ('per_sec', 'per_s', 'throughput', 'goodput',
+                           'mfu', 'rate', '_ok', 'samples', 'frac')
+_LOWER_IS_BETTER_HINTS = ('latency', 'seconds', '_ms', '_sec', 'wait',
+                          'stall', 'overhead', 'bytes_in_use')
+
+
+def metric_direction(name):
+  """+1 when higher is better, -1 when lower is better. Throughput-ish
+  hints are checked first: '_sec' must not claim 'mb_per_sec'."""
+  low = name.lower()
+  if any(h in low for h in _HIGHER_IS_BETTER_HINTS):
+    return 1
+  return -1 if any(h in low for h in _LOWER_IS_BETTER_HINTS) else 1
+
+
+def _median(values):
+  s = sorted(values)
+  n = len(s)
+  mid = n // 2
+  return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def robust_stats(values):
+  """(median, MAD) of a series."""
+  med = _median(values)
+  return med, _median([abs(v - med) for v in values])
+
+
+def judge_series(name, values, threshold=DEFAULT_THRESHOLD,
+                 min_rel_drop=DEFAULT_MIN_REL_DROP, min_points=MIN_POINTS):
+  """Judge the last point of ``values`` against the rest.
+
+  Returns a verdict dict (``status``: ``ok`` / ``regression`` /
+  ``insufficient-data``) with the statistics that justified it.
+  """
+  out = {'metric': name, 'points': len(values),
+         'latest': values[-1] if values else None}
+  if len(values) < min_points:
+    out['status'] = 'insufficient-data'
+    return out
+  baseline = values[:-1]
+  latest = values[-1]
+  med, mad = robust_stats(baseline)
+  scale = max(1.4826 * mad, min_rel_drop * abs(med), 1e-12)
+  z = (latest - med) / scale
+  direction = metric_direction(name)
+  rel_change = (latest - med) / abs(med) if med else 0.0
+  worse = direction * z < 0
+  out.update(baseline_median=round(med, 6), baseline_mad=round(mad, 6),
+             robust_z=round(z, 3), rel_change=round(rel_change, 4),
+             direction='higher-is-better' if direction > 0
+             else 'lower-is-better')
+  if worse and abs(z) > threshold and abs(rel_change) > min_rel_drop:
+    out['status'] = 'regression'
+  else:
+    out['status'] = 'ok'
+  return out
+
+
+# ---------------------------------------------------------------------------
+# history loaders: BENCH_r*.json, MULTICHIP_r*.json, bench-history JSONL
+
+
+def _numeric_items(d, prefix=''):
+  for k, v in d.items():
+    if isinstance(v, bool):
+      yield prefix + k, 1.0 if v else 0.0
+    elif isinstance(v, (int, float)):
+      yield prefix + k, float(v)
+
+
+def load_bench_rounds(root):
+  """Series from ``BENCH_r*.json`` driver rounds: the headline metric by
+  its own name, plus any extra numeric keys in ``parsed``."""
+  series = {}
+  for path in sorted(glob.glob(os.path.join(root, 'BENCH_r*.json'))):
+    try:
+      with open(path) as f:
+        rec = json.load(f)
+    except (OSError, ValueError):
+      continue
+    parsed = rec.get('parsed') or {}
+    metric = parsed.get('metric')
+    if metric and isinstance(parsed.get('value'), (int, float)):
+      series.setdefault(metric, []).append(float(parsed['value']))
+    for k, v in _numeric_items(parsed):
+      if k in ('value', 'vs_baseline') or k == 'metric':
+        continue
+      series.setdefault(k, []).append(v)
+  return series
+
+
+def load_multichip_rounds(root):
+  """``MULTICHIP_r*.json`` pass/fail as a 1.0/0.0 series (skipped rounds
+  are excluded rather than counted as failures)."""
+  values = []
+  for path in sorted(glob.glob(os.path.join(root, 'MULTICHIP_r*.json'))):
+    try:
+      with open(path) as f:
+        rec = json.load(f)
+    except (OSError, ValueError):
+      continue
+    if rec.get('skipped'):
+      continue
+    values.append(1.0 if rec.get('ok') else 0.0)
+  return {'multichip_smoke_ok': values} if values else {}
+
+
+def load_history_jsonl(path):
+  """Series from the bench-history JSONL ``bench.py`` appends: every
+  numeric field of each record, keyed by field name (nested ``parsed``
+  dicts flattened one level)."""
+  series = {}
+  try:
+    with open(path) as f:
+      lines = f.read().splitlines()
+  except OSError:
+    return series
+  for line in lines:
+    line = line.strip()
+    if not line:
+      continue
+    try:
+      rec = json.loads(line)
+    except ValueError:
+      continue
+    if not isinstance(rec, dict):
+      continue
+    flat = dict(_numeric_items(rec))
+    parsed = rec.get('parsed')
+    if isinstance(parsed, dict):
+      flat.update(_numeric_items(parsed))
+    metric = rec.get('metric')
+    if not metric and isinstance(parsed, dict):
+      metric = parsed.get('metric')
+    if isinstance(metric, str) and 'value' in flat:
+      flat[metric] = flat.pop('value')
+    for k, v in flat.items():
+      if k in ('n', 'rc', 'vs_baseline'):
+        continue
+      series.setdefault(k, []).append(v)
+  return series
+
+
+def append_history(path, record):
+  """Append one bench record to the history JSONL (used by bench.py)."""
+  parent = os.path.dirname(path)
+  if parent:
+    os.makedirs(parent, exist_ok=True)
+  with open(path, 'a') as f:
+    f.write(json.dumps(record, sort_keys=True) + '\n')
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def gather_series(root, history=None):
+  series = load_bench_rounds(root)
+  for name, values in load_multichip_rounds(root).items():
+    series.setdefault(name, []).extend(values)
+  if history is None:
+    candidate = os.path.join(root, 'bench_history.jsonl')
+    history = candidate if os.path.exists(candidate) else None
+  if history:
+    for name, values in load_history_jsonl(history).items():
+      series.setdefault(name, []).extend(values)
+  return series
+
+
+def attach_args(parser):
+  parser.add_argument('--root', default='.',
+                      help='directory holding BENCH_r*.json / '
+                           'MULTICHIP_r*.json (default: cwd)')
+  parser.add_argument('--history', default=None,
+                      help='bench-history JSONL (default: '
+                           '<root>/bench_history.jsonl when present)')
+  parser.add_argument('--threshold', type=float, default=DEFAULT_THRESHOLD,
+                      help='robust-z threshold (default %(default)s)')
+  parser.add_argument('--min-rel-drop', type=float,
+                      default=DEFAULT_MIN_REL_DROP,
+                      help='ignore changes smaller than this fraction of '
+                           'the baseline median (default %(default)s)')
+  parser.add_argument('--min-points', type=int, default=MIN_POINTS,
+                      help='series shorter than this are not judged '
+                           '(default %(default)s)')
+  parser.add_argument('--gate', action='store_true',
+                      help='exit 1 when any series regressed (CI mode)')
+  parser.add_argument('--json', action='store_true', dest='as_json',
+                      help='emit the full verdict list as JSON')
+  return parser
+
+
+def main(argv=None):
+  args = attach_args(argparse.ArgumentParser(
+      prog='lddl-perf',
+      description='robust perf-regression check over bench history')) \
+      .parse_args(argv)
+  series = gather_series(args.root, args.history)
+  if not series:
+    print(f'lddl-perf: no bench history under {args.root!r} '
+          '(expected BENCH_r*.json / MULTICHIP_r*.json / '
+          'bench_history.jsonl)', file=sys.stderr)
+    return 2
+  verdicts = [judge_series(name, values, threshold=args.threshold,
+                           min_rel_drop=args.min_rel_drop,
+                           min_points=args.min_points)
+              for name, values in sorted(series.items())]
+  regressions = [v for v in verdicts if v['status'] == 'regression']
+  if args.as_json:
+    print(json.dumps({'verdicts': verdicts,
+                      'regressions': len(regressions)}, indent=2))
+  else:
+    for v in verdicts:
+      line = f'{v["status"]:>18}  {v["metric"]}  n={v["points"]}'
+      if 'robust_z' in v:
+        line += (f'  latest={v["latest"]:g}  median={v["baseline_median"]:g}'
+                 f'  z={v["robust_z"]:+.2f}  rel={v["rel_change"]:+.1%}'
+                 f'  [{v["direction"]}]')
+      print(line)
+    if regressions:
+      names = ', '.join(v['metric'] for v in regressions)
+      print(f'lddl-perf: {len(regressions)} regression(s): {names}',
+            file=sys.stderr)
+  return 1 if (args.gate and regressions) else 0
+
+
+if __name__ == '__main__':
+  sys.exit(main())

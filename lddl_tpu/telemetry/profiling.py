@@ -1,0 +1,143 @@
+"""On-demand ``jax.profiler`` capture, shared by bench and live runs.
+
+Two entry points over one code path:
+
+  - :func:`trace_capture` — a context manager around one profiled region
+    (``benchmarks/train_bench.py --profile-dir`` uses this); no-op when
+    the directory is falsy, so callers never branch.
+  - :class:`StepProfiler` — the live-run half: ``GET /profile?steps=N``
+    on the monitor endpoint (or ``lddl-monitor --profile N``) *arms* the
+    profiler, and the train loop's per-step ``on_step()`` hook starts a
+    trace at the next step boundary and stops it N steps later. Traces
+    land under ``LDDL_TELEMETRY_DIR/profiles/`` (same layout the bench
+    context manager uses), numbered per capture, so a long pretrain can
+    be profiled without a restart and costs nothing while unarmed: the
+    unarmed ``on_step`` path is two attribute reads.
+
+The profiler singleton is plain state, not a thread or a socket — with
+``LDDL_MONITOR`` unset nothing ever arms it, preserving the PR 7 no-op
+guarantees (pinned by tests/test_monitor.py and tests/test_roofline.py).
+"""
+
+import contextlib
+import os
+import threading
+
+
+@contextlib.contextmanager
+def trace_capture(trace_dir):
+  """Profile the enclosed region into ``trace_dir`` (TensorBoard /
+  Perfetto layout). Falsy ``trace_dir`` → no-op, zero overhead."""
+  if not trace_dir:
+    yield None
+    return
+  import jax
+  os.makedirs(trace_dir, exist_ok=True)
+  jax.profiler.start_trace(trace_dir)
+  try:
+    yield trace_dir
+  finally:
+    jax.profiler.stop_trace()
+
+
+def default_profile_dir():
+  """Where live captures go: ``$LDDL_TELEMETRY_DIR/profiles`` (cwd-
+  relative ``lddl_profiles/`` when the telemetry dir is unset)."""
+  base = os.environ.get('LDDL_TELEMETRY_DIR')
+  return os.path.join(base, 'profiles') if base else 'lddl_profiles'
+
+
+class StepProfiler:
+  """Arms ``jax.profiler`` for the next N train steps.
+
+  ``arm()`` is called from the monitor's HTTP thread; ``on_step()`` from
+  the train loop. The hot path (unarmed) reads two attributes and
+  returns — no lock. The armed transitions take ``_lock`` so an arm
+  racing a step boundary cannot double-start a trace; jax allows only
+  one active trace per process.
+  """
+
+  def __init__(self):
+    self._lock = threading.Lock()
+    self._armed_steps = 0      # steps requested, not yet started
+    self._active_steps = 0     # steps remaining in a running trace
+    self._out_dir = None
+    self._capture_index = 0
+    self.last_trace_dir = None
+
+  def arm(self, steps, out_dir=None):
+    """Request a capture of the next ``steps`` train steps; returns the
+    directory the trace will land in. Re-arming while armed or active
+    replaces the pending request (it does not extend a running trace)."""
+    steps = max(1, int(steps))
+    with self._lock:
+      self._out_dir = out_dir or default_profile_dir()
+      self._armed_steps = steps
+      return self._out_dir
+
+  def on_step(self):
+    """Call once per train step, at the step boundary. Returns the trace
+    directory when this call *finished* a capture, else None."""
+    if not self._armed_steps and not self._active_steps:
+      return None
+    with self._lock:
+      if self._armed_steps and not self._active_steps:
+        import jax
+        n = self._capture_index
+        self._capture_index += 1
+        trace_dir = os.path.join(self._out_dir or default_profile_dir(),
+                                 f'capture{n:04d}')
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        self.last_trace_dir = trace_dir
+        self._active_steps = self._armed_steps
+        self._armed_steps = 0
+        return None
+      if self._active_steps:
+        self._active_steps -= 1
+        if self._active_steps == 0:
+          import jax
+          jax.profiler.stop_trace()
+          return self.last_trace_dir
+      return None
+
+  def close(self):
+    """Stop any in-flight trace (train-loop teardown); idempotent."""
+    with self._lock:
+      self._armed_steps = 0
+      if self._active_steps:
+        self._active_steps = 0
+        import jax
+        try:
+          jax.profiler.stop_trace()
+        except RuntimeError:
+          # jax raises when no trace is running — a crash between our
+          # start and this stop already tore the session down; the goal
+          # (no trace left open) holds either way.
+          pass
+
+  @property
+  def armed(self):
+    return bool(self._armed_steps or self._active_steps)
+
+
+_profiler = None
+_profiler_lock = threading.Lock()
+
+
+def get_step_profiler():
+  """The process-wide :class:`StepProfiler` (created on first use; plain
+  state, no threads)."""
+  global _profiler
+  if _profiler is None:
+    with _profiler_lock:
+      if _profiler is None:
+        _profiler = StepProfiler()
+  return _profiler
+
+
+def _reset_for_tests():
+  global _profiler
+  if _profiler is not None:
+    _profiler.close()
+  _profiler = None

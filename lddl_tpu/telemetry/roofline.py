@@ -1,0 +1,280 @@
+"""Roofline-aware device accounting: XLA step costs, chip peaks, HBM.
+
+The host-side observability plane (PR 7) can say *which stage* of the
+pipeline is slow; it cannot say whether the device itself is running
+against its compute ceiling or its memory ceiling, because its only
+device-cost signal is a formula-estimated FLOP count divided by a
+hand-set peak. This module adds the device-side half:
+
+  - **Exact per-step costs** from ``compiled.cost_analysis()``: XLA's
+    own FLOP and bytes-accessed totals for the optimized, SPMD-
+    partitioned program, captured once per (bin, shape) entry at
+    :class:`~lddl_tpu.training.pretrain.CompiledStepCache` compile time
+    (:func:`compiled_step_costs`). Steady-state cost per step is two
+    counter adds (``train.xla_flops`` / ``train.xla_bytes``).
+  - **Windowed roofline verdict** (:func:`roofline_verdict`): achieved
+    FLOP/s and bytes/s over the monitor's snapshot window vs the chip
+    peaks (:func:`resolve_peaks`), arithmetic intensity vs machine
+    balance, and a bound class — ``compute-bound`` / ``memory-bound`` /
+    ``input-bound`` — the distinction "Demystifying BERT"
+    (arXiv:2104.08335) shows flips with sequence length and batch shape.
+  - **HBM telemetry** (:func:`sample_hbm`): ``device.memory_stats()``
+    bytes-in-use / peak / limit gauges plus an OOM-headroom meter,
+    sampled at the scrape cadence (each ``/snapshot``), so an unwatched
+    process does no periodic device polling at all.
+
+Everything here is poll-driven or compile-time: with ``LDDL_MONITOR``
+and ``LDDL_TELEMETRY`` unset nothing in this module runs.
+"""
+
+import math
+import os
+
+
+# ---------------------------------------------------------------------------
+# exact per-step costs from the compiled executable
+
+
+def compiled_step_costs(compiled):
+  """(flops, bytes_accessed) of a compiled executable's *per-device*
+  partitioned module, or None when the runtime exposes no cost model.
+
+  ``cost_analysis()`` reports the post-optimization HLO module that one
+  device actually runs (an SPMD-partitioned program reports ~1/N of the
+  global math), so callers accounting whole-process work multiply by
+  the local device count. Returns None rather than raising on any
+  backend that lacks the analysis (an unsupported platform must not
+  break the train loop).
+  """
+  fn = getattr(compiled, 'cost_analysis', None)
+  if fn is None:
+    return None
+  try:
+    analysis = fn()
+  except Exception:
+    return None
+  if isinstance(analysis, (list, tuple)):
+    analysis = analysis[0] if analysis else None
+  if not isinstance(analysis, dict):
+    return None
+  flops = analysis.get('flops')
+  if not flops or flops <= 0:
+    return None
+  return float(flops), float(analysis.get('bytes accessed') or 0.0)
+
+
+# ---------------------------------------------------------------------------
+# chip peaks (table + env overrides), cached per process
+
+_peaks_cache = None
+
+
+def resolve_peaks(refresh=False):
+  """Per-process peak table for the roofline axes, resolved once.
+
+  Returns ``{'flops_per_sec', 'hbm_bytes_per_sec', 'balance',
+  'device_kind', 'local_devices'}`` where the peaks are *process totals*
+  (per-device peak × local device count) and ``balance`` is the ridge
+  point in FLOPs/byte. ``LDDL_PEAK_TFLOPS`` / ``LDDL_PEAK_HBM_GBPS``
+  (per device) override the chip table — required on hosts the table
+  cannot identify (CPU runs, unreleased chips), where the corresponding
+  axis is None and the verdict degrades honestly.
+  """
+  global _peaks_cache
+  if _peaks_cache is not None and not refresh:
+    return _peaks_cache
+  import jax
+
+  from ..models.flops import peak_flops_per_device, peak_hbm_bytes_per_device
+  device = jax.devices()[0]
+  n = jax.local_device_count()
+  env_flops = os.environ.get('LDDL_PEAK_TFLOPS')
+  env_bw = os.environ.get('LDDL_PEAK_HBM_GBPS')
+  per_dev_flops = (float(env_flops) * 1e12 if env_flops else
+                   peak_flops_per_device(device))
+  per_dev_bw = (float(env_bw) * 1e9 if env_bw else
+                peak_hbm_bytes_per_device(device))
+  _peaks_cache = {
+      'flops_per_sec': per_dev_flops * n if per_dev_flops else None,
+      'hbm_bytes_per_sec': per_dev_bw * n if per_dev_bw else None,
+      'balance': (per_dev_flops / per_dev_bw
+                  if per_dev_flops and per_dev_bw else None),
+      'device_kind': device.device_kind,
+      'local_devices': n,
+  }
+  return _peaks_cache
+
+
+# ---------------------------------------------------------------------------
+# the windowed verdict
+
+
+def _counter_total(metrics, name):
+  m = metrics.get(name)
+  return m.get('total', 0) if m and m.get('kind') == 'counter' else 0
+
+
+def _hist_sum(metrics, name):
+  m = metrics.get(name)
+  return m.get('sum', 0.0) if m and m.get('kind') == 'histogram' else 0.0
+
+
+# An input-starved device is neither compute- nor memory-bound no matter
+# what its arithmetic intensity says; same threshold the stage verdict
+# uses for its loader-vs-compute call.
+_INPUT_BOUND_WAIT_FRAC = 0.3
+
+
+def roofline_verdict(merged, window_sec, peaks=None):
+  """Bound-class verdict over a merged (windowed) metrics dict.
+
+  ``merged`` is :func:`~.report.merge_metric_lines` output — pass the
+  monitor window's delta for "right now", or a cumulative snapshot for
+  run-mean. Reads the ``train.xla_flops`` / ``train.xla_bytes`` counters
+  the compiled-step cache feeds and the data-wait/compute split, and
+  compares against ``peaks`` (default: :func:`resolve_peaks`).
+
+  Returns ``{'bound', 'detail', 'flops', 'bytes', 'flops_per_sec',
+  'bytes_per_sec', 'flops_frac', 'bw_frac', 'arithmetic_intensity',
+  'machine_balance', 'wait_frac', 'window_sec'}`` — fractions None when
+  the corresponding peak is unknown; ``bound`` is ``'compute-bound'``,
+  ``'memory-bound'``, ``'input-bound'``, or an ``'unknown (...)'``
+  explanation when the window carries no cost deltas.
+  """
+  metrics = merged.get('metrics', {})
+  flops = _counter_total(metrics, 'train.xla_flops')
+  nbytes = _counter_total(metrics, 'train.xla_bytes')
+  wait = _hist_sum(metrics, 'train.data_wait_seconds')
+  compute = _hist_sum(metrics, 'train.compute_seconds')
+  out = {
+      'flops': flops, 'bytes': nbytes,
+      'flops_per_sec': None, 'bytes_per_sec': None,
+      'flops_frac': None, 'bw_frac': None,
+      'arithmetic_intensity': None, 'machine_balance': None,
+      'wait_frac': None, 'window_sec': window_sec,
+  }
+  if not flops or window_sec <= 0:
+    out['bound'] = ('unknown (no compiled-step cost deltas in the window '
+                    '— is the train loop running with the step cache on?)')
+    out['detail'] = ''
+    return out
+  if peaks is None:
+    peaks = resolve_peaks()
+  peak_flops = peaks.get('flops_per_sec')
+  peak_bw = peaks.get('hbm_bytes_per_sec')
+  out['machine_balance'] = peaks.get('balance')
+  out['flops_per_sec'] = flops / window_sec
+  out['bytes_per_sec'] = nbytes / window_sec
+  if nbytes:
+    out['arithmetic_intensity'] = flops / nbytes
+  if peak_flops:
+    out['flops_frac'] = out['flops_per_sec'] / peak_flops
+  if peak_bw:
+    out['bw_frac'] = out['bytes_per_sec'] / peak_bw
+  if wait or compute:
+    out['wait_frac'] = wait / max(wait + compute, 1e-12)
+
+  if out['wait_frac'] is not None and \
+      out['wait_frac'] > _INPUT_BOUND_WAIT_FRAC:
+    out['bound'] = 'input-bound'
+    out['detail'] = (f'{100 * out["wait_frac"]:.0f}% of step time is data '
+                     'wait; the device ceiling is not the limiter')
+    return out
+  ai, balance = out['arithmetic_intensity'], out['machine_balance']
+  if ai is not None and balance is not None:
+    if ai >= balance:
+      out['bound'] = 'compute-bound'
+      out['detail'] = (f'arithmetic intensity {ai:.0f} FLOPs/byte >= '
+                       f'machine balance {balance:.0f}'
+                       + (f'; {100 * out["flops_frac"]:.0f}% of peak FLOPs'
+                          if out['flops_frac'] is not None else ''))
+    else:
+      out['bound'] = 'memory-bound'
+      out['detail'] = (f'arithmetic intensity {ai:.0f} FLOPs/byte < '
+                       f'machine balance {balance:.0f}'
+                       + (f'; {100 * out["bw_frac"]:.0f}% of peak HBM '
+                          'bandwidth'
+                          if out['bw_frac'] is not None else ''))
+    return out
+  out['bound'] = ('unknown (chip peaks unresolved — set LDDL_PEAK_TFLOPS '
+                  'and LDDL_PEAK_HBM_GBPS)')
+  out['detail'] = ''
+  return out
+
+
+def bound_class(merged, window_sec, peaks=None):
+  """Just the bound-class string (bench stamps, dashboards)."""
+  return roofline_verdict(merged, window_sec, peaks=peaks)['bound']
+
+
+# ---------------------------------------------------------------------------
+# HBM telemetry (device.memory_stats), sampled at the scrape cadence
+
+_MEMORY_STATS_KEYS = ('bytes_in_use', 'peak_bytes_in_use', 'bytes_limit')
+
+_hbm_supported = None  # None: not yet probed this process
+
+
+def sample_hbm(telemetry=None):
+  """Sample ``device.memory_stats()`` into ``hbm.*`` gauges; returns the
+  summary dict (or None where the runtime exposes no memory stats, e.g.
+  the CPU backend — probed once, then free).
+
+  Gauges (set only when telemetry is enabled):
+
+    - ``hbm.bytes_in_use`` / ``hbm.peak_bytes_in_use`` /
+      ``hbm.bytes_limit`` — summed over local devices;
+    - ``hbm.headroom_frac`` — the OOM-headroom meter: worst-case (min
+      over devices) ``1 - peak_bytes_in_use / bytes_limit``; a run that
+      ever neared its limit shows it here even between scrapes, because
+      ``peak_bytes_in_use`` is the allocator's high-water mark.
+  """
+  global _hbm_supported
+  if _hbm_supported is False:
+    return None
+  try:
+    import jax
+    devices = jax.local_devices()
+    per_device = [d.memory_stats() for d in devices]
+  except Exception:
+    _hbm_supported = False
+    return None
+  if not per_device or any(s is None for s in per_device):
+    _hbm_supported = False
+    return None
+  _hbm_supported = True
+  totals = {k: 0 for k in _MEMORY_STATS_KEYS}
+  headroom = math.inf
+  for stats in per_device:
+    for k in _MEMORY_STATS_KEYS:
+      totals[k] += int(stats.get(k, 0) or 0)
+    limit = stats.get('bytes_limit') or 0
+    if limit:
+      headroom = min(headroom,
+                     1.0 - (stats.get('peak_bytes_in_use', 0) or 0) / limit)
+  summary = {
+      'bytes_in_use': totals['bytes_in_use'],
+      'peak_bytes_in_use': totals['peak_bytes_in_use'],
+      'bytes_limit': totals['bytes_limit'],
+      'headroom_frac': headroom if math.isfinite(headroom) else None,
+      'devices': len(per_device),
+  }
+  if telemetry is None:
+    from .metrics import get_telemetry
+    telemetry = get_telemetry()
+  if telemetry.enabled:
+    telemetry.gauge('hbm.bytes_in_use').set(summary['bytes_in_use'])
+    telemetry.gauge('hbm.peak_bytes_in_use').set(
+        summary['peak_bytes_in_use'])
+    telemetry.gauge('hbm.bytes_limit').set(summary['bytes_limit'])
+    if summary['headroom_frac'] is not None:
+      telemetry.gauge('hbm.headroom_frac').set(summary['headroom_frac'])
+  return summary
+
+
+def _reset_for_tests():
+  """Clear the cached peak table and HBM support probe (tests flip env
+  overrides and fake platforms; the caches must re-resolve)."""
+  global _peaks_cache, _hbm_supported
+  _peaks_cache = None
+  _hbm_supported = None

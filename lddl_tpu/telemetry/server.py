@@ -22,20 +22,27 @@ does no periodic work at all.
     ``.rank<R>`` per rank.
 
 Each server announces itself by writing
-``monitor.rank<R>.pid<P>.json`` (url/rank/pid) into
-``LDDL_MONITOR_DIR`` (falling back to ``LDDL_TELEMETRY_DIR``), and
-removes it on stop — ``lddl-monitor --dir`` discovers a fleet from
-those files.
+``monitor.rank<R>.pid<P>.json`` (url/rank/pid, plus the pid's
+namespace and /proc starttime so discovery can tell a dead announcer
+from a live one — the same positive-death identity the comm backend's
+beacons use) into ``LDDL_MONITOR_DIR`` (falling back to
+``LDDL_TELEMETRY_DIR``), and removes it on stop — ``lddl-monitor
+--dir`` discovers a fleet from those files and skips announcers whose
+pid provably died.
 
 Endpoints:
 
   - ``GET /snapshot`` — :func:`~.live.live_status` as JSON: windowed
-    rates, the live bottleneck verdict, straggler signals, goodput
-    meters, plus the cumulative registry dump;
+    rates, the live bottleneck verdict (with its roofline sub-verdict),
+    straggler signals, goodput meters, HBM gauges, plus the cumulative
+    registry dump;
   - ``GET /metrics``  — Prometheus text exposition of the cumulative
     registry (counters/gauges/histograms with cumulative ``le`` buckets
     derived from the power-of-two log buckets);
-  - ``GET /healthz``  — liveness probe.
+  - ``GET /healthz``  — liveness probe;
+  - ``GET /profile?steps=N`` — arm ``jax.profiler`` for the next N
+    train steps (trace written under ``LDDL_TELEMETRY_DIR/profiles/``;
+    see :mod:`.profiling`).
 """
 
 import atexit
@@ -147,8 +154,25 @@ class _Handler(http.server.BaseHTTPRequestHandler):
           status = live_status(mon.window, rank=mon.rank)
         self._send(json.dumps(status, default=_json_default),
                    'application/json')
+      elif path == '/profile':
+        from urllib.parse import parse_qs
+        from .profiling import get_step_profiler
+        query = parse_qs(self.path.partition('?')[2])
+        try:
+          steps = int(query.get('steps', ['1'])[0])
+        except (ValueError, IndexError):
+          steps = 0
+        if steps < 1:
+          self.send_error(400, 'bad steps= value (want a positive int)')
+          return
+        trace_dir = get_step_profiler().arm(steps)
+        self._send(json.dumps({'armed_steps': steps,
+                               'trace_dir': trace_dir,
+                               'rank': mon.rank}),
+                   'application/json')
       else:
-        self.send_error(404, 'unknown endpoint (try /snapshot, /metrics)')
+        self.send_error(404, 'unknown endpoint (try /snapshot, /metrics, '
+                             '/healthz, /profile)')
     except BrokenPipeError:
       pass  # scraper went away mid-response; nothing to clean up
 
@@ -239,8 +263,16 @@ class MonitorServer:
     os.makedirs(directory, exist_ok=True)
     self._announce_path = os.path.join(
         directory, f'monitor.rank{self.rank}.pid{os.getpid()}.json')
+    # Ship the announcer's full pid identity (namespace + /proc
+    # starttime, the comm beacons' positive-death triple) so discovery
+    # can prove a SIGKILLed announcer dead instead of timing out on its
+    # stale endpoint.
+    from ..comm.backend import FileBackend
     payload = json.dumps({'url': self.url, 'rank': self.rank,
                           'pid': os.getpid(),
+                          'pidns': FileBackend._pid_namespace(),
+                          'pid_starttime':
+                              FileBackend._pid_starttime(os.getpid()),
                           'started_unix': time.time()})
     tmp = self._announce_path + '.tmp'
     with open(tmp, 'w') as f:

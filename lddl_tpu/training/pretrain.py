@@ -71,6 +71,13 @@ class CompiledStepCache:
   (``train.step_cache_hits``/``misses``, ``train.retrace_seconds``)
   prove bin switches after warmup cause zero retraces.
 
+  Compile time is also where XLA's exact cost model is free: each new
+  executable's ``cost_analysis()`` FLOPs/bytes are captured once per
+  (bin, shape) entry and re-billed per step as the
+  ``train.xla_flops`` / ``train.xla_bytes`` counters — the measured
+  numerators the roofline verdict and MFU gauge run on, at zero
+  steady-state cost (two counter adds per step).
+
   Disable with ``LDDL_STEP_CACHE=0`` (falls back to calling the jitted
   step directly).
   """
@@ -79,13 +86,20 @@ class CompiledStepCache:
     from ..telemetry import get_telemetry
     self.inner = step_fn
     self._compiled = {}
+    self._costs = {}   # key -> (process flops, process bytes) per step
     self.hits = 0
     self.misses = 0
     self.retrace_seconds = 0.0
+    # Process-total costs of the most recently executed entry (the MFU
+    # numerator); None until a compiled entry reported a cost model.
+    self.last_costs = None
     tele = get_telemetry()
+    self._tele = tele
     self._hits_c = tele.counter('train.step_cache_hits')
     self._misses_c = tele.counter('train.step_cache_misses')
     self._retrace_h = tele.histogram('train.retrace_seconds')
+    self._flops_c = tele.counter('train.xla_flops')
+    self._bytes_c = tele.counter('train.xla_bytes')
 
   @staticmethod
   def key_of(batch):
@@ -100,6 +114,15 @@ class CompiledStepCache:
       lower = getattr(self.inner, 'lower', None)
       if lower is not None:
         fn = lower(params, opt_state, rng, batch).compile()
+        # cost_analysis() reports the per-device partitioned module;
+        # scale to the process total once here so the per-step billing
+        # below is two plain adds.
+        from ..telemetry.roofline import compiled_step_costs
+        costs = compiled_step_costs(fn)
+        if costs is not None:
+          import jax
+          n = jax.local_device_count()
+          self._costs[key] = (costs[0] * n, costs[1] * n)
       else:
         fn = self.inner  # plain-callable step fns still work, uncached
       dt = time.perf_counter() - t0
@@ -111,6 +134,12 @@ class CompiledStepCache:
     else:
       self.hits += 1
       self._hits_c.add(1)
+    costs = self._costs.get(key)
+    if costs is not None:
+      self.last_costs = costs
+      if self._tele.enabled:
+        self._flops_c.add(costs[0])
+        self._bytes_c.add(costs[1])
     return fn(params, opt_state, rng, batch)
 
 
@@ -310,11 +339,15 @@ class TrainLoop:
 
     from ..loader.device import prefetch_to_device
     from ..telemetry import get_telemetry
+    from ..telemetry.profiling import get_step_profiler
     from ..telemetry.server import maybe_start_monitor
     from ..telemetry.trace import get_tracer
 
     # Live metrics endpoint (LDDL_MONITOR): no-op singleton when unset.
     maybe_start_monitor(rank=max(jax.process_index(), 0))
+    # GET /profile?steps=N arms this; unarmed on_step() is two attribute
+    # reads, so the hook costs nothing on unwatched runs.
+    profiler = get_step_profiler()
     global_batch = self.loader.batch_size * max(jax.process_count(), 1)
     tele = get_telemetry()
     tracer = get_tracer()
@@ -363,6 +396,10 @@ class TrainLoop:
         losses.append(loss)
         self.step += 1
         self.samples_seen += global_batch
+        finished_trace = profiler.on_step()
+        if finished_trace:
+          print(f'profiler: wrote trace for step {self.step} window to '
+                f'{finished_trace}')
         if tracer.enabled:
           tm_now = time.monotonic()
           tracer.complete('train.compute', tm_step, tm_now - tm_step,
@@ -378,11 +415,22 @@ class TrainLoop:
           samples_c.add(self.loader.batch_size)
           tele.gauge('train.samples_per_sec').set(
               self.loader.batch_size / max(now - t_wait, 1e-9))
-          if peak_total and self.flops_fn is not None:
-            b, s = batch['input_ids'].shape
-            tele.gauge('train.mfu').set(
-                self.flops_fn(b, s) /
-                (max(now - t_wait, 1e-9) * peak_total))
+          if peak_total:
+            # Prefer XLA's own cost model (captured at compile time by
+            # the step cache) over the analytic estimate: the measured
+            # numerator reflects fusion, remat, and the real partitioned
+            # program, so MFU stops drifting from what the chip ran.
+            measured = getattr(self.step_fn, 'last_costs', None)
+            if measured is not None:
+              numerator = measured[0]
+            elif self.flops_fn is not None:
+              b, s = batch['input_ids'].shape
+              numerator = self.flops_fn(b, s)
+            else:
+              numerator = None
+            if numerator:
+              tele.gauge('train.mfu').set(
+                  numerator / (max(now - t_wait, 1e-9) * peak_total))
           if 'segment_ids' in batch:
             # Host-side mirror of the kernel's tile-skip rule: the
             # goodput signal for how much attention work block-diagonal
@@ -411,6 +459,9 @@ class TrainLoop:
             'loader yielded zero batches for a full epoch (dataset smaller '
             'than one global batch?); refusing to spin — reduce '
             '--batch-size or provide more data')
+    # A capture armed near the end of the run may still be tracing; jax
+    # allows one trace per process, so close it before returning.
+    profiler.close()
     # Skip when the in-loop ckpt_every save (or the restore we started
     # from) already covers this step: orbax refuses duplicate steps.
     if ckpt_dir and self._last_saved != self.step:

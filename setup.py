@@ -42,6 +42,7 @@ setup(
             'lddl_tpu.cli:generate_num_samples_cache',
             'lddl-analyze=lddl_tpu.analysis.cli:main',
             'lddl-monitor=lddl_tpu.telemetry.monitor:main',
+            'lddl-perf=lddl_tpu.telemetry.perf:main',
         ],
     },
 )

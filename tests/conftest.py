@@ -42,6 +42,8 @@ def _reset_telemetry_registries():
   ``LDDL_TELEMETRY``/``LDDL_TRACE`` and re-resolving) without disabling
   must not leak an enabled registry into later tests."""
   import lddl_tpu.telemetry.metrics as _tm
+  import lddl_tpu.telemetry.profiling as _tp
+  import lddl_tpu.telemetry.roofline as _tr
   import lddl_tpu.telemetry.server as _ts
   import lddl_tpu.telemetry.trace as _tt
   old = (_tm._active, _tt._active)
@@ -52,6 +54,10 @@ def _reset_telemetry_registries():
   if _ts._active is not None and _ts._active.enabled:
     _ts._active.stop()
   _ts._active = None
+  # Device-side caches: tests flip LDDL_PEAK_* env overrides and arm the
+  # step profiler; both must re-resolve per test.
+  _tr._reset_for_tests()
+  _tp._reset_for_tests()
 
 
 WORDS = [

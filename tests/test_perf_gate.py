@@ -1,0 +1,187 @@
+"""``lddl-perf``: the robust perf-regression gate over bench history.
+
+The load-bearing contracts:
+
+  - the repo's REAL ``BENCH_r01..r05.json`` trajectory passes the gate
+    (its swings are growth noise, not cliffs — the acceptance
+    criterion), while a fixture history with an injected cliff exits
+    non-zero and benign MAD-scale noise does not;
+  - median ± MAD statistics with the min-rel-drop floor: a single
+    outlier in the baseline cannot poison the scale, and near-constant
+    series never flag measurement jitter;
+  - direction inference: throughput-ish names are higher-is-better
+    (``_sec`` inside ``per_sec`` must not flip them), latency-ish names
+    lower-is-better — improvements never gate;
+  - loaders ingest all three sources (BENCH rounds, MULTICHIP rounds,
+    the bench-history JSONL ``bench.py`` appends) and the CLI is wired
+    into ``python -m lddl_tpu.cli``.
+"""
+
+import json
+import os
+
+import pytest
+
+from lddl_tpu.telemetry.perf import (append_history, gather_series,
+                                     judge_series, load_bench_rounds,
+                                     load_history_jsonl,
+                                     load_multichip_rounds, main,
+                                     metric_direction, robust_stats)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_history(path, values, metric='tput_rows_per_sec'):
+  with open(path, 'w') as f:
+    for v in values:
+      f.write(json.dumps({'metric': metric, 'value': v}) + '\n')
+  return str(path)
+
+
+# ---------------------------------------------------------------------------
+# the statistics
+
+
+class TestJudgeSeries:
+
+  def test_cliff_flags(self):
+    v = judge_series('tput_rows_per_sec', [10.0, 10.1, 9.9, 10.05, 3.0])
+    assert v['status'] == 'regression'
+    assert v['robust_z'] < -4.0
+
+  def test_benign_mad_scale_noise_passes(self):
+    v = judge_series('tput_rows_per_sec', [10.0, 10.4, 9.6, 10.2, 9.7])
+    assert v['status'] == 'ok'
+
+  def test_wide_growth_trajectory_passes(self):
+    # The shape of the repo's real rounds: orders-of-magnitude growth
+    # with a final value below the median. Robust scale must absorb it.
+    v = judge_series('mb_per_sec_per_chip',
+                     [0.801, 8.28, 10.433, 16.049, 6.913])
+    assert v['status'] == 'ok'
+
+  def test_improvement_never_flags(self):
+    v = judge_series('tput_rows_per_sec', [10.0, 10.1, 9.9, 10.05, 30.0])
+    assert v['status'] == 'ok'
+    # ...and for lower-is-better metrics a drop is the improvement.
+    v = judge_series('step_latency_ms', [10.0, 10.1, 9.9, 10.05, 3.0])
+    assert v['status'] == 'ok'
+    v = judge_series('step_latency_ms', [10.0, 10.1, 9.9, 10.05, 30.0])
+    assert v['status'] == 'regression'
+
+  def test_short_series_insufficient(self):
+    v = judge_series('x_per_sec', [10.0, 3.0])
+    assert v['status'] == 'insufficient-data'
+
+  def test_constant_series_ignores_jitter(self):
+    # MAD = 0; the min-rel-drop floor keeps a 2% wobble from flagging.
+    v = judge_series('tput_rows_per_sec', [10.0, 10.0, 10.0, 10.0, 9.8])
+    assert v['status'] == 'ok'
+    v = judge_series('tput_rows_per_sec', [10.0, 10.0, 10.0, 10.0, 5.0])
+    assert v['status'] == 'regression'
+
+  def test_robust_stats(self):
+    med, mad = robust_stats([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert med == 3.0
+    assert mad == 1.0  # the outlier does not poison the scale
+
+  def test_direction_inference(self):
+    assert metric_direction('bert_preprocess_mb_per_sec_per_chip') == 1
+    assert metric_direction('train_samples_per_sec') == 1
+    assert metric_direction('multichip_smoke_ok') == 1
+    assert metric_direction('step_latency_ms') == -1
+    assert metric_direction('data_wait_seconds') == -1
+    assert metric_direction('hbm_bytes_in_use') == -1
+
+
+# ---------------------------------------------------------------------------
+# loaders
+
+
+class TestLoaders:
+
+  def test_real_bench_rounds_load(self):
+    series = load_bench_rounds(REPO_ROOT)
+    values = series.get('bert_preprocess_mb_per_sec_per_chip')
+    assert values and len(values) >= 5
+    assert values[0] == pytest.approx(0.801)
+
+  def test_real_multichip_rounds_load(self):
+    series = load_multichip_rounds(REPO_ROOT)
+    assert all(v in (0.0, 1.0)
+               for v in series.get('multichip_smoke_ok', []))
+
+  def test_history_roundtrip(self, tmp_path):
+    path = str(tmp_path / 'hist.jsonl')
+    append_history(path, {'metric': 'm_per_sec', 'value': 1.5, 'n': 1})
+    append_history(path, {'metric': 'm_per_sec', 'value': 2.5, 'n': 2,
+                          'parsed': {'extra_per_sec': 7.0}})
+    series = load_history_jsonl(path)
+    assert series['m_per_sec'] == [1.5, 2.5]
+    assert series['extra_per_sec'] == [7.0]
+    assert 'n' not in series  # round counters are not metrics
+
+  def test_history_tolerates_garbage_lines(self, tmp_path):
+    path = tmp_path / 'hist.jsonl'
+    path.write_text('not json\n{"metric": "x_per_sec", "value": 1.0}\n\n')
+    assert load_history_jsonl(str(path)) == {'x_per_sec': [1.0]}
+    assert load_history_jsonl(str(tmp_path / 'missing.jsonl')) == {}
+
+  def test_gather_merges_rounds_and_history(self, tmp_path):
+    for i, v in enumerate([1.0, 2.0]):
+      (tmp_path / f'BENCH_r0{i + 1}.json').write_text(json.dumps(
+          {'n': i + 1, 'parsed': {'metric': 'm_per_sec', 'value': v}}))
+    _write_history(tmp_path / 'bench_history.jsonl', [3.0, 4.0],
+                   metric='m_per_sec')
+    series = gather_series(str(tmp_path))
+    assert series['m_per_sec'] == [1.0, 2.0, 3.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# the CLI gate
+
+
+class TestGateCli:
+
+  def test_real_repo_trajectory_passes_gate(self, capsys):
+    assert main(['--root', REPO_ROOT, '--gate']) == 0
+    out = capsys.readouterr().out
+    assert 'bert_preprocess_mb_per_sec_per_chip' in out
+
+  def test_injected_cliff_fails_gate(self, tmp_path, capsys):
+    _write_history(tmp_path / 'bench_history.jsonl',
+                   [10.0, 10.1, 9.9, 10.05, 3.0])
+    assert main(['--root', str(tmp_path), '--gate']) == 1
+    assert 'regression' in capsys.readouterr().out
+
+  def test_benign_noise_passes_gate(self, tmp_path):
+    _write_history(tmp_path / 'bench_history.jsonl',
+                   [10.0, 10.4, 9.6, 10.2, 9.7])
+    assert main(['--root', str(tmp_path), '--gate']) == 0
+
+  def test_without_gate_regressions_report_but_exit_zero(self, tmp_path):
+    _write_history(tmp_path / 'bench_history.jsonl',
+                   [10.0, 10.1, 9.9, 10.05, 3.0])
+    assert main(['--root', str(tmp_path)]) == 0
+
+  def test_no_inputs_exits_two(self, tmp_path, capsys):
+    assert main(['--root', str(tmp_path)]) == 2
+    assert 'no bench history' in capsys.readouterr().err
+
+  def test_json_output(self, tmp_path, capsys):
+    _write_history(tmp_path / 'bench_history.jsonl',
+                   [10.0, 10.1, 9.9, 10.05, 3.0])
+    assert main(['--root', str(tmp_path), '--json']) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload['regressions'] == 1
+    assert payload['verdicts'][0]['metric'] == 'tput_rows_per_sec'
+
+  def test_cli_wiring(self):
+    from lddl_tpu.cli import _COMMANDS
+    assert 'lddl_perf' in _COMMANDS
+    assert 'lddl-perf' in _COMMANDS
+
+  def test_console_entry_registered(self):
+    with open(os.path.join(REPO_ROOT, 'setup.py')) as f:
+      setup_src = f.read()
+    assert 'lddl-perf=lddl_tpu.telemetry.perf:main' in setup_src

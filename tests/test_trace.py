@@ -386,6 +386,12 @@ def _trace_smoke_worker(rank, rdzv, shards_dir, vocab, out_dir, q):
     os.environ['LDDL_TRACE'] = '1'
     os.environ['LDDL_TELEMETRY'] = '1'
     os.environ['LDDL_TELEMETRY_DIR'] = out_dir
+    # Static stride: with elastic lease claims, whichever rank reaches
+    # map() first grabs all 8 trivial tasks and the other rank's
+    # stage0.task lane comes up empty. This test asserts lane
+    # *rendering* on both ranks, so pin the deterministic split
+    # (elastic claim distribution is tests/test_faults.py territory).
+    os.environ['LDDL_ELASTIC'] = '0'
     from lddl_tpu.comm import FileBackend
     from lddl_tpu.loader import get_bert_pretrain_data_loader
     from lddl_tpu.pipeline import Executor
