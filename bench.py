@@ -153,6 +153,8 @@ def main():
     from lddl_tpu.preprocess.bert import BertPretrainConfig, run
     from lddl_tpu.preprocess.common import native_columnar_enabled
     from lddl_tpu.preprocess.readers import read_corpus
+    from lddl_tpu.training.elastic import (async_ckpt_enabled,
+                                           elastic_train_enabled)
 
     import dataclasses
     cfg = BertPretrainConfig(
@@ -260,6 +262,12 @@ def main():
             'elastic': executor.scheduler_info().get('elastic', False),
             'lease_timeout_sec': lease_timeout(),
             'heartbeat_sec': comm_heartbeat_interval(),
+            # Train-side elastic regime: membership polling and the
+            # background checkpoint lane both shift the measured step
+            # cadence, so the BENCH line records them (PERF.md keys its
+            # async-ckpt overlap note off this stamp).
+            'elastic_train': elastic_train_enabled(executor.comm),
+            'async_ckpt': async_ckpt_enabled(),
         },
         'resume': {
             'resumable': executor.scheduler_info().get('elastic', False),

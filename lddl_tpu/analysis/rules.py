@@ -538,7 +538,8 @@ class ElasticPathPurity(ProjectRule):
   # Roots are matched by definition/class name so the rule holds for
   # the real executor and for fixtures shaped like it.
   ROOT_DEFS = ('Executor._map_elastic',)
-  ROOT_CLASSES = ('_LeaseClaimer', '_HeartbeatPump')
+  ROOT_CLASSES = ('_LeaseClaimer', '_HeartbeatPump', 'HeartbeatPump',
+                  'RankMembership')
 
   def _roots(self, index):
     roots = []

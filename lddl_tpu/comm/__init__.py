@@ -2,6 +2,7 @@ from .backend import (
     CommBackend,
     FileBackend,
     FileLeaseStore,
+    HeartbeatPump,
     JaxProcessBackend,
     KVLeaseStore,
     LeaseStore,

@@ -21,6 +21,7 @@ reference's "N local processes" test pattern).
 import os
 import pickle
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -463,6 +464,52 @@ class LeaseStore:
     """Positive-signal death probe for rank ``r`` (False when the
     substrate cannot prove death — staleness timeouts then rule)."""
     return False
+
+
+class HeartbeatPump:
+  """Background lease heartbeat for one elastic phase or train fleet.
+
+  Republishes a monotonically increasing counter every interval while
+  the rank executes — the main thread may block for minutes inside pool
+  waits or compiled train steps, so liveness cannot ride the claim or
+  collective traffic itself. The value is a counter, not a timestamp:
+  observers measure staleness of an *unchanging* counter on their own
+  clock, so cross-host clock skew can never manufacture a revocation.
+
+  ``fault_site``: optional :mod:`lddl_tpu.core.faults` site injected
+  inside the republish attempt (the train membership pump passes
+  ``train.heartbeat``), so kill-style specs can silence a rank's
+  liveness and raise-style specs exercise the absorbed-transient path
+  a flaky substrate would.
+  """
+
+  def __init__(self, store, interval, fault_site=None):
+    self._store = store
+    self._interval = interval
+    self._fault_site = fault_site
+    self._stop = threading.Event()
+    self._beats = 0
+    # First beat lands before any claim this rank makes: a peer that
+    # sees our claim can always already see a heartbeat to age.
+    self._store.heartbeat(0)
+    self._thread = threading.Thread(
+        target=self._run, name='lddl-lease-hb', daemon=True)
+    self._thread.start()
+
+  def _run(self):
+    while not self._stop.wait(self._interval):
+      self._beats += 1
+      try:
+        if self._fault_site:
+          faults.inject(self._fault_site,
+                        rank=getattr(self._store, 'rank', 0))
+        self._store.heartbeat(self._beats)
+      except OSError:
+        continue  # transient substrate flap: the next beat retries
+
+  def stop(self):
+    self._stop.set()
+    self._thread.join(timeout=5.0)
 
 
 class FileLeaseStore(LeaseStore):

@@ -14,6 +14,9 @@ independently)::
   <action>:<site>[:k=v,...]
 
   actions:  kill    SIGKILL the current process (no cleanup, no atexit)
+            term    SIGTERM the current process (handlers run — the
+                    graceful-preemption drill the train loop's
+                    emergency-checkpoint hook is tested with)
             raise   raise OSError('injected fault ...')
             delay   sleep ``sec`` seconds (default 0.1)
   filters:  rank=R  only when the caller passes rank=R
@@ -27,8 +30,13 @@ independently)::
 
 Instrumented sites: ``elastic.task`` (executor lease-claimed task entry),
 ``pool.task`` (pool worker task entry), ``comm.write`` (FileBackend
-atomic write). ``inject()`` is a no-op (one env read) when
-``LDDL_FAULTS`` is unset, so production paths pay nothing measurable.
+atomic write), ``train.step`` (train-loop step entry, after the batch
+is pulled), ``train.ckpt`` (checkpoint write entry — fires on the
+background writer thread under ``LDDL_ASYNC_CKPT``, so raise-specs
+exercise the first-error-wins surfacing), ``train.heartbeat`` (the
+train membership pump's republish attempt). ``inject()`` is a no-op
+(one env read) when ``LDDL_FAULTS`` is unset, so production paths pay
+nothing measurable.
 """
 
 import os
@@ -55,6 +63,13 @@ def _once_marker(spec):
 def _fire(action, site, opts):
   if action == 'kill':
     os.kill(os.getpid(), signal.SIGKILL)
+  if action == 'term':
+    # Delivered to this process's own handlers (unlike 'kill'): the
+    # preemption drill — the signal lands synchronously on the main
+    # thread's next bytecode boundary, so a loop checking its guard
+    # right after this call already sees the flag.
+    os.kill(os.getpid(), signal.SIGTERM)
+    return
   if action == 'raise':
     raise OSError(f'injected fault at {site}')
   if action == 'delay':

@@ -18,6 +18,7 @@ TPU-idiomatic equivalents:
 """
 
 import collections
+import os
 import queue
 import threading
 
@@ -206,14 +207,37 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
     stop.set()
     # Serialize with the producer: after close() returns, the source
     # iterator is guaranteed quiescent (it may be mid-pull right now, e.g.
-    # finishing an epoch and mutating loader state).
-    t.join()
+    # finishing an epoch and mutating loader state). Bounded: on the
+    # preemption path a wedged upstream (dead shm peer, hung mount) must
+    # not eat the grace window the emergency checkpoint needs, so after
+    # the timeout the daemon thread is abandoned with a loud warning —
+    # only the epoch-rebuild path relies on quiescence, and it only runs
+    # after a clean, prompt join.
+    t.join(timeout=_close_join_timeout())
+    if t.is_alive():
+      import warnings
+      warnings.warn(
+          'prefetch producer still running '
+          f'{_close_join_timeout():g}s after close(); abandoning the '
+          'daemon thread (source iterator may not be quiescent)')
+      tele.counter('loader.prefetch_join_timeouts').add(1)
     if tele.enabled and live_sizes:
       # The stream is closed and the producer joined: whatever we still
       # tracked is dead (yielded refs are dropped with the generator).
       live_sizes.clear()
       live_bytes_g.set(0)
       live_batches_g.set(0)
+
+
+def _close_join_timeout():
+  """Bound on waiting out the prefetch producer at close() (env
+  ``LDDL_PREFETCH_JOIN_TIMEOUT`` seconds, default 10 — inside the ~30s
+  spot-preemption grace window with room left for the checkpoint)."""
+  try:
+    return max(0.1,
+               float(os.environ.get('LDDL_PREFETCH_JOIN_TIMEOUT', '10')))
+  except ValueError:
+    return 10.0
 
 
 def _delete_device_batch(item):

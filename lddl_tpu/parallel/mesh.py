@@ -15,6 +15,7 @@ holds here by construction: the loader shards batches as
 """
 
 import collections
+import re
 
 import jax
 import numpy as np
@@ -91,3 +92,73 @@ def canonical_batch_spec(mesh, shape, data_axis=None, seq_axis=None):
 def mesh_summary(mesh):
   shape = collections.OrderedDict(zip(mesh.axis_names, mesh.devices.shape))
   return ', '.join(f'{k}={v}' for k, v in shape.items())
+
+
+def _leaf_name(path):
+  """'/'-joined tree path of one pytree leaf (dict keys, attribute
+  names, and sequence indices all stringify)."""
+  parts = []
+  for p in path:
+    for attr in ('key', 'name', 'idx'):
+      v = getattr(p, attr, None)
+      if v is not None:
+        parts.append(str(v))
+        break
+    else:
+      parts.append(str(p))
+  return '/'.join(parts)
+
+
+def match_partition_rules(rules, tree):
+  """Map every leaf of ``tree`` to a ``PartitionSpec`` by regex rules.
+
+  ``rules`` is an ordered ``[(pattern, PartitionSpec), ...]``; each
+  leaf's '/'-joined tree path is searched against the patterns in order
+  and the first match wins — the rescalable-placement idiom of the
+  DrJAX-style resharding resume (PAPERS.md, arXiv:2403.07128), where a
+  checkpoint restored onto a *different* mesh re-derives every leaf's
+  layout from its name instead of from the dead run's device topology.
+  Scalar (0-d) leaves are replicated without consulting the rules; a
+  non-scalar leaf no rule matches raises — silently replicating a large
+  tensor is exactly the quiet OOM this API exists to prevent.
+  """
+  from jax.tree_util import tree_flatten_with_path, tree_unflatten
+  flat, treedef = tree_flatten_with_path(tree)
+  specs = []
+  for path, leaf in flat:
+    if getattr(leaf, 'ndim', 0) == 0:
+      specs.append(P())
+      continue
+    name = _leaf_name(path)
+    for pattern, spec in rules:
+      if re.search(pattern, name):
+        specs.append(spec)
+        break
+    else:
+      raise ValueError(f'no partition rule matches leaf {name!r}')
+  return tree_unflatten(treedef, specs)
+
+
+def reshard_pytree(tree, mesh, like=None, rules=None):
+  """Re-place every leaf of ``tree`` onto ``mesh``.
+
+  The world-size-resharding primitive of checkpoint restore: state
+  written on one mesh is laid out onto the (possibly differently sized
+  or shaped) mesh of the resumed run. Placement comes from exactly one
+  of:
+
+  - ``like``: a template tree already living on ``mesh`` — each leaf
+    adopts the matching template leaf's sharding (the restore path,
+    where ``TrainLoop.build`` has already produced the new mesh's
+    canonical layout);
+  - ``rules``: ``[(regex, PartitionSpec), ...]`` resolved by
+    :func:`match_partition_rules` against leaf tree paths.
+  """
+  if (like is None) == (rules is None):
+    raise ValueError('pass exactly one of like= / rules=')
+  if like is not None:
+    return jax.tree_util.tree_map(
+        lambda n, o: jax.device_put(n, o.sharding), tree, like)
+  specs = match_partition_rules(rules, tree)
+  return jax.tree_util.tree_map(
+      lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs)

@@ -55,6 +55,30 @@ def init_params(model, mesh, rng, seq_len=128, batch=2):
   return jax.jit(init_fn, out_shardings=shardings)()
 
 
+def snapshot_for_checkpoint(tree):
+  """Donation-safe copy of a state pytree for background checkpointing.
+
+  :func:`make_train_step` donates params/opt_state, so the *next* step
+  call invalidates the buffers a background checkpoint writer would
+  still be serializing. The snapshot must therefore happen
+  synchronously at submit time: fully-addressable leaves come back as
+  host numpy arrays (the single-host case — orbax then serializes host
+  memory and never touches the donated originals); multi-host global
+  arrays get an on-device copy that preserves their sharding in fresh
+  buffers, so donating the originals is harmless. Non-array leaves
+  pass through.
+  """
+
+  def _copy(x):
+    if not isinstance(x, jax.Array):
+      return x
+    if x.is_fully_addressable:
+      return jax.device_get(x)
+    return jnp.copy(x)
+
+  return jax.tree_util.tree_map(_copy, tree)
+
+
 def per_doc_mlm_loss(mlm_ce, masked, seg, num_docs_cap):
   """Packing-aware MLM normalization (arXiv:2107.02027 §3.2).
 

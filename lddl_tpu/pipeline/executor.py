@@ -31,7 +31,7 @@ import threading
 import time
 import weakref
 
-from ..comm import NullBackend, comm_heartbeat_interval
+from ..comm import HeartbeatPump, NullBackend, comm_heartbeat_interval
 from ..core import faults
 from ..telemetry import get_telemetry
 from ..telemetry.server import maybe_start_monitor
@@ -109,40 +109,10 @@ def _publish_error_manifest(store, gi, err):
     return
 
 
-class _HeartbeatPump:
-  """Background lease heartbeat for one elastic phase.
-
-  Republishes a monotonically increasing counter every interval while
-  the rank executes — the main thread may block for minutes inside pool
-  waits, so liveness cannot ride the claim traffic itself. The value is
-  a counter, not a timestamp: observers measure staleness of an
-  *unchanging* counter on their own clock, so cross-host clock skew can
-  never manufacture a revocation.
-  """
-
-  def __init__(self, store, interval):
-    self._store = store
-    self._interval = interval
-    self._stop = threading.Event()
-    self._beats = 0
-    # First beat lands before any claim this rank makes: a peer that
-    # sees our claim can always already see a heartbeat to age.
-    self._store.heartbeat(0)
-    self._thread = threading.Thread(
-        target=self._run, name='lddl-lease-hb', daemon=True)
-    self._thread.start()
-
-  def _run(self):
-    while not self._stop.wait(self._interval):
-      self._beats += 1
-      try:
-        self._store.heartbeat(self._beats)
-      except OSError:
-        continue  # transient substrate flap: the next beat retries
-
-  def stop(self):
-    self._stop.set()
-    self._thread.join(timeout=5.0)
+# The heartbeat pump moved to comm/backend.py (PR 13: the train fleet's
+# lease-based membership shares it); the old private name stays bound for
+# this module's call site and any external references.
+_HeartbeatPump = HeartbeatPump
 
 
 class _LeaseClaimer:

@@ -79,21 +79,28 @@ class AsyncShardWriter:
   tables are ever held in memory. ``flush()`` blocks until every
   submitted job has run and re-raises the first failure — callers must
   flush before treating a phase's output as durable.
+
+  ``counter``/``thread_name`` parameterize the telemetry identity so
+  other write-back consumers (the trainer's async checkpoint writer)
+  can reuse the same overlap-and-flush discipline without billing their
+  completions to the pool's straggler signal.
   """
 
-  def __init__(self, max_pending=None):
+  def __init__(self, max_pending=None, counter='pipeline.pool.writes',
+               thread_name='lddl-write-back'):
     self._q = _queue.Queue(max_pending or _write_back_depth())
     self._err = None
+    self._counter = counter
     self.backlog_hwm = 0  # max queue depth observed since last reset
     self._thread = threading.Thread(
-        target=self._run, name='lddl-write-back', daemon=True)
+        target=self._run, name=thread_name, daemon=True)
     self._thread.start()
 
   def _run(self):
     # Completed write-backs are the straggler signal for the write side
     # (windowed writes/sec vs the fleet median in telemetry.live); the
     # handle is fetched once per writer thread, off the submit path.
-    writes = get_telemetry().counter('pipeline.pool.writes')
+    writes = get_telemetry().counter(self._counter)
     while True:
       job = self._q.get()
       if job is None:
@@ -116,10 +123,25 @@ class AsyncShardWriter:
     manifest must never vouch for a shard write that did not land."""
     return self._err is not None
 
+  @property
+  def backlog(self):
+    """Jobs currently queued (the checkpoint-backlog gauge input)."""
+    return self._q.qsize()
+
   def _raise_pending(self):
     if self._err is not None:
       raise WriteBackError(
           'background shard write failed:\n' + self._err)
+
+  def raise_pending(self):
+    """Surface the first background failure, if any (first-error-wins).
+
+    Cheap enough for a per-step check: one attribute test on the happy
+    path. Callers overlapping writes with a compute loop poll this so a
+    lost write stops the loop at the next step instead of at the next
+    flush boundary.
+    """
+    self._raise_pending()
 
   def submit(self, fn, *args, **kwargs):
     """Enqueue one write job (blocks when ``max_pending`` are in flight)."""

@@ -178,9 +178,10 @@ def goodput_meters(merged):
       block-diagonal packed path skipped outright (cross-document
       tiles the flash/ring kernels never compute) — 0 under full
       attention, approaches (k-1)/k at k docs per packed row;
-    - ``queue_depth`` / ``shm_slot_occupancy`` / ``writer_backlog``:
-      backpressure gauges (mean/min/max) from the loader transport and
-      the async shard writer.
+    - ``queue_depth`` / ``shm_slot_occupancy`` / ``writer_backlog`` /
+      ``ckpt_backlog``: backpressure gauges (mean/min/max) from the
+      loader transport, the async shard writer, and the async
+      checkpoint writer.
   """
   metrics = merged['metrics']
   out = {}
@@ -226,6 +227,7 @@ def goodput_meters(merged):
   out['queue_depth'] = _gauge(metrics, 'loader.queue_depth')
   out['shm_slot_occupancy'] = _gauge(metrics, 'loader.shm_slot_occupancy')
   out['writer_backlog'] = _gauge(metrics, 'pipeline.pool.writer_backlog')
+  out['ckpt_backlog'] = _gauge(metrics, 'train.ckpt_backlog')
 
   out['mfu'] = _gauge(metrics, 'train.mfu')
   # Device-memory meters: the prefetcher's live-array accounting (the
@@ -253,6 +255,13 @@ def goodput_meters(merged):
                                        'pipeline.elastic.resume_skipped'),
       'pool_respawns': _counter_total(metrics, 'pipeline.pool.respawns'),
       'io_retries': _counter_total(metrics, 'comm.io_retries'),
+      'train_preemptions': _counter_total(metrics,
+                                          'train.elastic.preemptions'),
+      'train_dead_ranks': _counter_total(metrics,
+                                         'train.elastic.dead_ranks'),
+      'train_sheds': _counter_total(metrics, 'train.elastic.sheds'),
+      'train_rejoins': _counter_total(metrics, 'train.elastic.rejoins'),
+      'async_ckpt_writes': _counter_total(metrics, 'train.ckpt_writes'),
   }
   out['fault_tolerance'] = ft if any(ft.values()) else None
   return out

@@ -218,7 +218,7 @@ def render_frame(fleet, clear=True):
     if good.get('device_live_batches'):
       meters.append(f'device-live {good["device_live_batches"]["mean"]:.1f}'
                     ' batches')
-    for g in ('queue_depth', 'shm_slot_occupancy'):
+    for g in ('queue_depth', 'shm_slot_occupancy', 'ckpt_backlog'):
       if good.get(g):
         meters.append(f'{g} {good[g]["mean"]:.1f}')
     if meters:

@@ -280,6 +280,11 @@ def render_report(merged):
       'resume-skipped': 'pipeline.elastic.resume_skipped',
       'pool workers respawned': 'pipeline.pool.respawns',
       'comm IO retries': 'comm.io_retries',
+      'train preemptions': 'train.elastic.preemptions',
+      'train dead ranks': 'train.elastic.dead_ranks',
+      'train ranks shed': 'train.elastic.sheds',
+      'train rank rejoins': 'train.elastic.rejoins',
+      'async ckpt writes': 'train.ckpt_writes',
   }
   ft_lines = []
   for title, name in ft_counters.items():

@@ -165,6 +165,11 @@ class TrainLoop:
   # (per_rank_batch, seq_len) -> analytic FLOPs of one train step; set by
   # build() so run() can report MFU without re-deriving the model config.
   flops_fn: object = None
+  dp_rank: int = 0
+  dp_world: int = 1
+  # Why the last run() stopped early (preemption / membership event), or
+  # None when it ran to max_steps. The supervisor's relaunch signal.
+  stop_reason: object = None
   _last_saved: int = dataclasses.field(default=-1, repr=False)
 
   @classmethod
@@ -173,7 +178,7 @@ class TrainLoop:
             batch_size_per_rank=64, bin_size=None, max_seq_length=512,
             masking='dynamic', seed=127, samples_seen=0, loader_kwargs=None,
             max_predictions=None, data_format='pairs',
-            block_diagonal=False):
+            block_diagonal=False, dp_rank=None, dp_world=None):
     import jax
     import optax
 
@@ -187,7 +192,12 @@ class TrainLoop:
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
     tx = optax.adamw(schedule, weight_decay=weight_decay)
-    dp_rank, dp_world = jax.process_index(), jax.process_count()
+    # Overridable for elastic resume: a fleet reformed at a different
+    # world size passes its new coordinates explicitly (and the file-
+    # backend multi-rank tests run several dp ranks inside independent
+    # single-process jax worlds).
+    dp_rank = jax.process_index() if dp_rank is None else dp_rank
+    dp_world = jax.process_count() if dp_world is None else dp_world
     if block_diagonal and data_format != 'packed':
       raise ValueError("block_diagonal requires data_format='packed' "
                        '(pair shards carry no doc_offsets)')
@@ -239,7 +249,8 @@ class TrainLoop:
     return cls(model=model, tx=tx, mesh=mesh, loader=loader, params=params,
                opt_state=opt_state, rng=jax.random.key(seed + 1),
                step_fn=step_fn, samples_seen=samples_seen,
-               step=samples_seen // global_batch, flops_fn=flops_fn)
+               step=samples_seen // global_batch, flops_fn=flops_fn,
+               dp_rank=dp_rank, dp_world=dp_world)
 
   # ---- checkpointing ----
 
@@ -250,39 +261,85 @@ class TrainLoop:
         options=ocp.CheckpointManagerOptions(max_to_keep=keep,
                                              create=True))
 
-  def save(self, ckpt_dir, keep=3):
-    """Write (params, opt_state, rng, counters) at the current step."""
+  def save(self, ckpt_dir, keep=3, writer=None):
+    """Write (params, opt_state, rng, counters) at the current step.
+
+    With ``writer`` (an :class:`~lddl_tpu.training.elastic.
+    AsyncCheckpointWriter`) the orbax write runs on the background
+    thread over a donation-safe snapshot taken here, synchronously —
+    the jitted step donates params/opt_state, so the *next* step call
+    invalidates the live buffers and the copy cannot wait for the
+    writer. Submit blocks only at the writer's bounded depth; a failed
+    background write surfaces on the next :meth:`save`/``raise_pending``
+    /``flush`` (first-error-wins).
+    """
     import jax
-    import orbax.checkpoint as ocp
-    mngr = self._manager(ckpt_dir, keep)
     state = {'params': self.params, 'opt_state': self.opt_state,
              'rng': jax.random.key_data(self.rng)}
-    mngr.save(
-        self.step,
-        args=ocp.args.Composite(
-            state=ocp.args.StandardSave(state),
-            meta=ocp.args.JsonSave({'samples_seen': self.samples_seen,
-                                    'step': self.step})))
-    mngr.wait_until_finished()
-    mngr.close()
+    meta = {'samples_seen': self.samples_seen, 'step': self.step}
+    if writer is not None:
+      from ..parallel.train import snapshot_for_checkpoint
+      from ..telemetry import get_telemetry
+      snap = snapshot_for_checkpoint(state)
+      writer.submit(self._write_ckpt, ckpt_dir, keep, self.step, snap, meta)
+      get_telemetry().gauge('train.ckpt_backlog').set(writer.backlog)
+    else:
+      self._write_ckpt(ckpt_dir, keep, self.step, state, meta)
     self._last_saved = self.step
     return self.step
 
+  def _write_ckpt(self, ckpt_dir, keep, step, state, meta):
+    """The actual orbax write — runs inline (sync save) or on the
+    async writer's thread, where a raised fault/IO error is retained
+    first-error-wins instead of crashing the step loop."""
+    import orbax.checkpoint as ocp
+    from ..core import faults
+    faults.inject('train.ckpt', rank=self.dp_rank)
+    mngr = self._manager(ckpt_dir, keep)
+    mngr.save(
+        step,
+        args=ocp.args.Composite(
+            state=ocp.args.StandardSave(state),
+            meta=ocp.args.JsonSave(meta)))
+    mngr.wait_until_finished()
+    mngr.close()
+
   @staticmethod
   def latest_meta(ckpt_dir):
-    """(step, samples_seen) of the newest checkpoint, or None."""
+    """(step, samples_seen) of the newest *readable* checkpoint, or None.
+
+    Robust by design — this is the first call of every restarted rank:
+    directory reads retry transient IO errors with the comm layer's
+    bounded backoff, and a half-finished newest step (a preemption
+    landing mid-write) is skipped in favor of the next-older complete
+    one rather than failing the resume.
+    """
     import orbax.checkpoint as ocp
+
+    from ..comm.backend import _retry_io
     if not os.path.isdir(ckpt_dir):
       return None
-    mngr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
-    step = mngr.latest_step()
-    if step is None:
-      mngr.close()
+    mngr = _retry_io(
+        lambda: ocp.CheckpointManager(os.path.abspath(ckpt_dir)),
+        'open checkpoint dir')
+    try:
+      steps = sorted(_retry_io(mngr.all_steps, 'list checkpoint steps'),
+                     reverse=True)
+      for step in steps:
+        try:
+          meta = mngr.restore(step, args=ocp.args.Composite(
+              meta=ocp.args.JsonRestore()))['meta']
+          return meta['step'], meta['samples_seen']
+        except Exception as e:
+          # A half-written step dir (preemption mid-write): fall back to
+          # the next-older step instead of failing the whole resume.
+          logging.getLogger('lddl_tpu').warning(
+              'checkpoint step %s in %s unreadable (%s: %s); trying an '
+              'older step', step, ckpt_dir, type(e).__name__, e)
+          continue
       return None
-    meta = mngr.restore(step, args=ocp.args.Composite(
-        meta=ocp.args.JsonRestore()))['meta']
-    mngr.close()
-    return meta['step'], meta['samples_seen']
+    finally:
+      mngr.close()
 
   def restore(self, ckpt_dir):
     """Restore sharded state from the newest checkpoint in ``ckpt_dir``.
@@ -290,32 +347,42 @@ class TrainLoop:
     The loader must already have been built with the checkpoint's
     ``samples_seen`` (use :meth:`latest_meta` before :meth:`build`);
     this method restores the device state onto the existing shardings.
+    The existing shardings may belong to a *different* mesh than the
+    one the checkpoint was written on — ``build()`` lays the template
+    tree out canonically on whatever mesh the resumed run has, and
+    every restored leaf is re-placed through
+    :func:`~lddl_tpu.parallel.mesh.reshard_pytree`, so world-size-
+    changing resume (2 ranks die, restart on 1; or scale 1 -> 8) is the
+    same code path as same-size resume.
     """
     import jax
     import orbax.checkpoint as ocp
+
+    from ..comm.backend import _retry_io
+    from ..parallel import reshard_pytree
     mngr = self._manager(ckpt_dir)
-    step = mngr.latest_step()
+    step = _retry_io(mngr.latest_step, 'find latest checkpoint')
     if step is None:
       raise FileNotFoundError(f'no checkpoint under {ckpt_dir}')
     target = {'params': self.params, 'opt_state': self.opt_state,
               'rng': jax.random.key_data(self.rng)}
-    restored = mngr.restore(
-        step,
-        args=ocp.args.Composite(
-            state=ocp.args.StandardRestore(target),
-            meta=ocp.args.JsonRestore()))
+    restored = _retry_io(
+        lambda: mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(target),
+                meta=ocp.args.JsonRestore())), 'restore checkpoint')
     mngr.close()
 
     # Re-place every leaf onto the template's sharding: orbax restores
     # unsharded scalars (e.g. the optimizer step count) onto a single
     # device, which would then conflict with the mesh-sharded params
-    # inside the jitted step.
-    def _like(new, old):
-      return jax.tree_util.tree_map(
-          lambda n, o: jax.device_put(n, o.sharding), new, old)
-
-    self.params = _like(restored['state']['params'], self.params)
-    self.opt_state = _like(restored['state']['opt_state'], self.opt_state)
+    # inside the jitted step — and on a resized fleet the template's
+    # mesh is the *new* topology the leaves must land on.
+    self.params = reshard_pytree(restored['state']['params'], self.mesh,
+                                 like=self.params)
+    self.opt_state = reshard_pytree(restored['state']['opt_state'],
+                                    self.mesh, like=self.opt_state)
     # Replicate the restored key over the mesh: orbax hands back an array
     # committed to one device, and a committed single-device key conflicts
     # with mesh-sharded params inside the jitted step (a fresh
@@ -333,22 +400,36 @@ class TrainLoop:
   # ---- the loop ----
 
   def run(self, max_steps, ckpt_dir=None, ckpt_every=0, log_every=50,
-          prefetch=2):
-    """Train until ``max_steps`` (global); returns per-step loss list."""
+          prefetch=2, membership=None, async_ckpt=None):
+    """Train until ``max_steps`` (global); returns per-step loss list.
+
+    Preemption-tolerant: a SIGTERM (or ``LDDL_PREEMPTION_FILE`` notice)
+    stops the loop at the next step boundary behind one final
+    synchronous checkpoint; a :class:`~lddl_tpu.training.elastic.
+    RankMembership` passed as ``membership`` is polled at its heartbeat
+    cadence and any fleet event (dead peer, shed verdict) likewise
+    stops the loop checkpointed, with :attr:`stop_reason` telling the
+    supervisor why. ``async_ckpt`` overrides ``LDDL_ASYNC_CKPT``:
+    in-loop checkpoints ride the background writer, overlapping orbax
+    IO with compute.
+    """
     import jax
 
+    from ..core import faults
     from ..loader.device import prefetch_to_device
     from ..telemetry import get_telemetry
     from ..telemetry.profiling import get_step_profiler
     from ..telemetry.server import maybe_start_monitor
     from ..telemetry.trace import get_tracer
+    from .elastic import (AsyncCheckpointWriter, PreemptionGuard,
+                          async_ckpt_enabled)
 
     # Live metrics endpoint (LDDL_MONITOR): no-op singleton when unset.
     maybe_start_monitor(rank=max(jax.process_index(), 0))
     # GET /profile?steps=N arms this; unarmed on_step() is two attribute
     # reads, so the hook costs nothing on unwatched runs.
     profiler = get_step_profiler()
-    global_batch = self.loader.batch_size * max(jax.process_count(), 1)
+    global_batch = self.loader.batch_size * max(self.dp_world, 1)
     tele = get_telemetry()
     tracer = get_tracer()
     data_wait_h = tele.histogram('train.data_wait_seconds')
@@ -364,108 +445,156 @@ class TrainLoop:
       # Persisted on the loop (not run()-local) so repeated run() calls —
       # and every epoch within one — keep the warm per-bin executables.
       self.step_fn = CompiledStepCache(self.step_fn)
+    self.stop_reason = None
+    use_async = async_ckpt_enabled() if async_ckpt is None else async_ckpt
+    writer = AsyncCheckpointWriter() if (ckpt_dir and use_async) else None
+    guard = PreemptionGuard().install()
+    # Membership poll cadence + the steps_per_sec window it publishes.
+    poll_at = time.monotonic()
+    rate_anchor = (self.step, time.monotonic())
     losses = []
-    while self.step < max_steps:
-      stream = prefetch_to_device(iter(self.loader), mesh=self.mesh,
-                                  size=prefetch)
-      t0 = time.perf_counter()
-      steps_this_epoch = 0
-      while True:
-        # Pull the batch explicitly so the stall waiting on the input
-        # pipeline (data wait) is timed separately from the step itself:
-        # the split is the report's loader-vs-compute bottleneck signal.
-        t_wait = time.perf_counter()
-        tm_wait = time.monotonic() if tracer.enabled else 0.0
-        try:
-          batch = next(stream)
-        except StopIteration:
-          break
-        t_step = time.perf_counter()
-        tm_step = time.monotonic() if tracer.enabled else 0.0
-        if tracer.enabled:
-          tracer.complete('train.data_wait', tm_wait, tm_step - tm_wait,
-                          args={'step': self.step})
-        data_wait_h.observe(t_step - t_wait)
-        steps_this_epoch += 1
-        step_no = self.step
-        self.params, self.opt_state, metrics = self.step_fn(
-            self.params, self.opt_state, self.rng, batch)
-        # float() blocks until the device finishes the step, so the
-        # compute span covers real execution, not just dispatch.
-        loss = float(metrics['loss'])
-        losses.append(loss)
-        self.step += 1
-        self.samples_seen += global_batch
-        finished_trace = profiler.on_step()
-        if finished_trace:
-          print(f'profiler: wrote trace for step {self.step} window to '
-                f'{finished_trace}')
-        if tracer.enabled:
-          tm_now = time.monotonic()
-          tracer.complete('train.compute', tm_step, tm_now - tm_step,
-                          args={'step': step_no})
-          tracer.counter('train.samples_per_sec',
-                         self.loader.batch_size / max(tm_now - tm_wait,
-                                                      1e-9))
-        if tele.enabled:
-          now = time.perf_counter()
-          compute_h.observe(now - t_step)
-          step_h.observe(now - t_wait)
-          steps_c.add(1)
-          samples_c.add(self.loader.batch_size)
-          tele.gauge('train.samples_per_sec').set(
-              self.loader.batch_size / max(now - t_wait, 1e-9))
-          if peak_total:
-            # Prefer XLA's own cost model (captured at compile time by
-            # the step cache) over the analytic estimate: the measured
-            # numerator reflects fusion, remat, and the real partitioned
-            # program, so MFU stops drifting from what the chip ran.
-            measured = getattr(self.step_fn, 'last_costs', None)
-            if measured is not None:
-              numerator = measured[0]
-            elif self.flops_fn is not None:
-              b, s = batch['input_ids'].shape
-              numerator = self.flops_fn(b, s)
-            else:
-              numerator = None
-            if numerator:
-              tele.gauge('train.mfu').set(
-                  numerator / (max(now - t_wait, 1e-9) * peak_total))
-          if 'segment_ids' in batch:
-            # Host-side mirror of the kernel's tile-skip rule: the
-            # goodput signal for how much attention work block-diagonal
-            # packing actually removed this step.
-            import numpy as np
+    try:
+      while self.step < max_steps and self.stop_reason is None:
+        stream = prefetch_to_device(iter(self.loader), mesh=self.mesh,
+                                    size=prefetch)
+        t0 = time.perf_counter()
+        steps_this_epoch = 0
+        while True:
+          # Pull the batch explicitly so the stall waiting on the input
+          # pipeline (data wait) is timed separately from the step itself:
+          # the split is the report's loader-vs-compute bottleneck signal.
+          t_wait = time.perf_counter()
+          tm_wait = time.monotonic() if tracer.enabled else 0.0
+          try:
+            batch = next(stream)
+          except StopIteration:
+            break
+          t_step = time.perf_counter()
+          tm_step = time.monotonic() if tracer.enabled else 0.0
+          if tracer.enabled:
+            tracer.complete('train.data_wait', tm_wait, tm_step - tm_wait,
+                            args={'step': self.step})
+          data_wait_h.observe(t_step - t_wait)
+          # After the batch pull, before the step: a 'kill' here models a
+          # rank dying mid-training, a 'term' models the preemption notice.
+          faults.inject('train.step', rank=self.dp_rank)
+          steps_this_epoch += 1
+          step_no = self.step
+          self.params, self.opt_state, metrics = self.step_fn(
+              self.params, self.opt_state, self.rng, batch)
+          # float() blocks until the device finishes the step, so the
+          # compute span covers real execution, not just dispatch.
+          loss = float(metrics['loss'])
+          losses.append(loss)
+          self.step += 1
+          self.samples_seen += global_batch
+          finished_trace = profiler.on_step()
+          if finished_trace:
+            print(f'profiler: wrote trace for step {self.step} window to '
+                  f'{finished_trace}')
+          if tracer.enabled:
+            tm_now = time.monotonic()
+            tracer.complete('train.compute', tm_step, tm_now - tm_step,
+                            args={'step': step_no})
+            tracer.counter('train.samples_per_sec',
+                           self.loader.batch_size / max(tm_now - tm_wait,
+                                                        1e-9))
+          if tele.enabled:
+            now = time.perf_counter()
+            compute_h.observe(now - t_step)
+            step_h.observe(now - t_wait)
+            steps_c.add(1)
+            samples_c.add(self.loader.batch_size)
+            tele.gauge('train.samples_per_sec').set(
+                self.loader.batch_size / max(now - t_wait, 1e-9))
+            if peak_total:
+              # Prefer XLA's own cost model (captured at compile time by
+              # the step cache) over the analytic estimate: the measured
+              # numerator reflects fusion, remat, and the real partitioned
+              # program, so MFU stops drifting from what the chip ran.
+              measured = getattr(self.step_fn, 'last_costs', None)
+              if measured is not None:
+                numerator = measured[0]
+              elif self.flops_fn is not None:
+                b, s = batch['input_ids'].shape
+                numerator = self.flops_fn(b, s)
+              else:
+                numerator = None
+              if numerator:
+                tele.gauge('train.mfu').set(
+                    numerator / (max(now - t_wait, 1e-9) * peak_total))
+            if 'segment_ids' in batch:
+              # Host-side mirror of the kernel's tile-skip rule: the
+              # goodput signal for how much attention work block-diagonal
+              # packing actually removed this step.
+              import numpy as np
 
-            from ..ops.flash_attention import count_skippable_tiles
-            total, skipped = count_skippable_tiles(
-                np.asarray(batch['segment_ids']))
-            tiles_total_c.add(total)
-            tiles_skipped_c.add(skipped)
-        if log_every and self.step % log_every == 0:
-          dt = time.perf_counter() - t0
-          t0 = time.perf_counter()
-          print(f'step={self.step} loss={loss:.4f} '
-                f'samples_seen={self.samples_seen} '
-                f'({log_every * global_batch / max(dt, 1e-9):.1f} '
-                'samples/s)')
-        if ckpt_dir and ckpt_every and self.step % ckpt_every == 0:
-          self.save(ckpt_dir)
-        if self.step >= max_steps:
-          break
-      stream.close()
-      if steps_this_epoch == 0:
-        raise ValueError(
-            'loader yielded zero batches for a full epoch (dataset smaller '
-            'than one global batch?); refusing to spin — reduce '
-            '--batch-size or provide more data')
-    # A capture armed near the end of the run may still be tracing; jax
-    # allows one trace per process, so close it before returning.
-    profiler.close()
-    # Skip when the in-loop ckpt_every save (or the restore we started
-    # from) already covers this step: orbax refuses duplicate steps.
-    if ckpt_dir and self._last_saved != self.step:
-      self.save(ckpt_dir)
+              from ..ops.flash_attention import count_skippable_tiles
+              total, skipped = count_skippable_tiles(
+                  np.asarray(batch['segment_ids']))
+              tiles_total_c.add(total)
+              tiles_skipped_c.add(skipped)
+          if log_every and self.step % log_every == 0:
+            dt = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            print(f'step={self.step} loss={loss:.4f} '
+                  f'samples_seen={self.samples_seen} '
+                  f'({log_every * global_batch / max(dt, 1e-9):.1f} '
+                  'samples/s)')
+          if writer is not None:
+            # First-error-wins: a checkpoint that died in the background
+            # fails the run at the next step, not at the final flush.
+            writer.raise_pending()
+          if guard.requested:
+            self.stop_reason = 'preempted'
+          elif membership is not None:
+            now_m = time.monotonic()
+            # lddl: noqa[LDA003] membership poll cadence: the clock only
+            # rate-limits lease-store sweeps to one per heartbeat interval;
+            # a late poll delays noticing an already-recorded fleet event,
+            # it never changes any rank's verdict.
+            if now_m >= poll_at:
+              poll_at = now_m + membership.interval
+              w_step, w_t = rate_anchor
+              membership.publish_signals(
+                  {'steps_per_sec':
+                   (self.step - w_step) / max(now_m - w_t, 1e-9)})
+              rate_anchor = (self.step, now_m)
+              self.stop_reason = membership.poll()
+          if self.stop_reason is not None:
+            break
+          if ckpt_dir and ckpt_every and self.step % ckpt_every == 0:
+            self.save(ckpt_dir, writer=writer)
+          if self.step >= max_steps:
+            break
+        stream.close()
+        if steps_this_epoch == 0 and self.stop_reason is None:
+          raise ValueError(
+              'loader yielded zero batches for a full epoch (dataset smaller '
+              'than one global batch?); refusing to spin — reduce '
+              '--batch-size or provide more data')
+      # A capture armed near the end of the run may still be tracing; jax
+      # allows one trace per process, so close it before returning.
+      profiler.close()
+      if writer is not None:
+        # Bounded by the already-submitted saves; raises the first
+        # retained background failure.
+        writer.flush()
+      # Skip when the in-loop ckpt_every save (or the restore we started
+      # from) already covers this step: orbax refuses duplicate steps.
+      # After a preemption or membership stop this synchronous trailing
+      # save IS the emergency checkpoint — complete before the return.
+      if ckpt_dir and self._last_saved != self.step:
+        self.save(ckpt_dir)
+    finally:
+      guard.uninstall()
+      if writer is not None:
+        # Idempotent after flush(); raise_errors=False so cleanup
+        # never masks an exception already propagating.
+        writer.close(raise_errors=False)
+    if self.stop_reason is not None:
+      print(f'stopping early: {self.stop_reason} '
+            f'(step={self.step} samples_seen={self.samples_seen})')
     return losses
 
 
@@ -643,9 +772,15 @@ def main(args=None):
       block_diagonal=args.block_diagonal)
   if resume:
     loop.restore(args.checkpoint_dir)
-  losses = loop.run(args.steps, ckpt_dir=args.checkpoint_dir,
-                    ckpt_every=args.checkpoint_every,
-                    log_every=args.log_every)
+  from .elastic import maybe_membership
+  membership = maybe_membership(comm, step=loop.step)
+  try:
+    losses = loop.run(args.steps, ckpt_dir=args.checkpoint_dir,
+                      ckpt_every=args.checkpoint_every,
+                      log_every=args.log_every, membership=membership)
+  finally:
+    if membership is not None:
+      membership.stop()
   export_telemetry(comm)
   if losses:
     print(json.dumps({'final_step': loop.step,

@@ -352,6 +352,197 @@ class TestLeaseRevokeDeterminism:
     assert len(wins) == 1, 're-claim after revocation must have one winner'
 
 
+# ---------------------------------------------------------------------------
+# elastic training: dead-rank detection, emergency checkpoint, resharded resume
+
+
+def _train_rank(rdv, rank, world, bal, vocab_file, ckpt_dir, env, q):
+  """One elastic train rank in its own 2-device CPU jax world, sharing a
+  FileBackend membership store; the injected fault SIGKILLs rank 1
+  mid-training and rank 0 must detect the death via the pid probe,
+  land a final checkpoint, and stop with a dead_rank verdict."""
+  os.environ.update(env)
+  try:
+    import jax.numpy as jnp
+
+    from lddl_tpu.models import BertConfig
+    from lddl_tpu.parallel import make_mesh
+    from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+    from lddl_tpu.training.elastic import RankMembership
+    from lddl_tpu.training.pretrain import TrainLoop
+
+    be = FileBackend(rdv, rank, world, timeout=60.0, run_id='train')
+    tok = load_bert_tokenizer(vocab_file=vocab_file, backend='hf')
+    cfg = BertConfig(
+        vocab_size=((tok.vocab_size + 63) // 64) * 64, hidden_size=32,
+        num_layers=2, num_heads=2, intermediate_size=64,
+        max_position_embeddings=64, dropout_rate=0.0, dtype=jnp.float32)
+    loop = TrainLoop.build(
+        bal, tok, model_cfg=cfg, mesh=make_mesh(), learning_rate=1e-3,
+        warmup_steps=2, total_steps=100, batch_size_per_rank=4,
+        bin_size=8, max_seq_length=32, seed=5, dp_rank=rank,
+        dp_world=world, loader_kwargs={'shuffle_buffer_size': 16})
+    membership = RankMembership(
+        be.lease_store('train.membership'), rank, world).start()
+    be.barrier()  # both ranks are members before any fault can fire
+    try:
+      # max_steps is unreachable: only a membership event can end rank
+      # 0's run (a hang here fails the parent's queue timeout).
+      losses = loop.run(100, ckpt_dir=(ckpt_dir if rank == 0 else None),
+                        ckpt_every=2, log_every=0, membership=membership)
+    finally:
+      membership.stop()
+    q.put((rank, 'completed',
+           {'stop_reason': loop.stop_reason, 'step': loop.step,
+            'samples_seen': loop.samples_seen, 'steps_run': len(losses)}))
+  except BaseException as e:  # noqa: BLE001 - report everything
+    q.put((rank, 'error', f'{type(e).__name__}: {e}'))
+
+
+class TestTrainRankDeath:
+
+  def test_sigkill_train_rank_fleet_checkpoints_and_resumes(self, tmp_path):
+    """SIGKILL one of two train ranks mid-run: the survivor detects the
+    dead rank through the lease membership (positive death probe — the
+    60s staleness timeout would blow the deadline), checkpoints, and
+    stops with a dead_rank stop_reason; the parent then resumes the
+    checkpoint at world size 1 (different mesh, preserved global batch)
+    and two independent restores agree on parameters AND the forward
+    bin-draw sequence."""
+    import itertools
+
+    import jax
+    import numpy as np
+
+    import __graft_entry__ as g
+    from lddl_tpu.tokenization.wordpiece import load_bert_tokenizer
+    from lddl_tpu.training.pretrain import TrainLoop
+
+    bal, vocab_file, _ = g.build_tiny_dataset(str(tmp_path), num_shards=4)
+    ckpt_dir = str(tmp_path / 'ckpt')
+    rdv = str(tmp_path / 'rdv')
+    base_env = {
+        'JAX_PLATFORMS': 'cpu',
+        'XLA_FLAGS': '--xla_force_host_platform_device_count=2',
+        'LDDL_LEASE_TIMEOUT': '60',  # force the death-probe path
+        'LDDL_COMM_HEARTBEAT': '0.2',
+    }
+    ctx = multiprocessing.get_context('spawn')
+    q = ctx.Queue()
+    procs = []
+    for r in range(2):
+      env = dict(base_env)
+      if r == 0:
+        env['LDDL_ASYNC_CKPT'] = '1'  # the background checkpoint lane
+      else:
+        env['LDDL_FAULTS'] = 'kill:train.step:rank=1,nth=6'
+      procs.append(ctx.Process(
+          target=_train_rank,
+          args=(rdv, r, 2, bal, vocab_file, ckpt_dir, env, q),
+          daemon=True))
+    t0 = time.monotonic()
+    for p in procs:
+      p.start()
+    rank, kind, info = q.get(timeout=300)
+    elapsed = time.monotonic() - t0
+    for p in procs:
+      p.join(timeout=60)
+    assert procs[1].exitcode == -signal.SIGKILL
+    assert (rank, kind) == (0, 'completed'), (rank, kind, info)
+    assert str(info['stop_reason']).startswith('dead_rank:'), info
+    # The survivor made progress and stopped on the verdict, not a hang
+    # (rank 0 steps slower than the doomed rank — it owns checkpointing
+    # — so its step count at detection is small but nonzero).
+    assert info['steps_run'] >= 1, info
+    assert elapsed < 240.0, (
+        f'survivor took {elapsed:.0f}s — detection must ride the death '
+        'probe, not the lease timeout')
+    # The emergency checkpoint is complete and current.
+    meta = TrainLoop.latest_meta(ckpt_dir)
+    assert meta == (info['step'], info['samples_seen'])
+
+    # Resharding resume: restore at world size 1 on THIS process's
+    # 8-device mesh, per-rank batch 8 keeping the global batch at
+    # 4 x 2 = 8, so the data position replays identically.
+    import jax.numpy as jnp
+
+    from lddl_tpu.models import BertConfig
+    from lddl_tpu.parallel import make_mesh
+    tok = load_bert_tokenizer(vocab_file=vocab_file, backend='hf')
+    cfg = BertConfig(
+        vocab_size=((tok.vocab_size + 63) // 64) * 64, hidden_size=32,
+        num_layers=2, num_heads=2, intermediate_size=64,
+        max_position_embeddings=64, dropout_rate=0.0, dtype=jnp.float32)
+
+    def resume():
+      loop = TrainLoop.build(
+          bal, tok, model_cfg=cfg, mesh=make_mesh(), learning_rate=1e-3,
+          warmup_steps=2, total_steps=100, batch_size_per_rank=8,
+          bin_size=8, max_seq_length=32, seed=5,
+          samples_seen=meta[1], dp_rank=0, dp_world=1,
+          loader_kwargs={'shuffle_buffer_size': 16})
+      return loop.restore(ckpt_dir)
+
+    a, b = resume(), resume()
+    assert a.step == meta[0] and a.samples_seen == meta[1]
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)),
+        a.params, b.params)
+    seq_a = [bt['input_ids'].shape[1]
+             for bt in itertools.islice(iter(a.loader), 4)]
+    seq_b = [bt['input_ids'].shape[1]
+             for bt in itertools.islice(iter(b.loader), 4)]
+    assert seq_a == seq_b, 'resumed loader positions diverged'
+
+
+class TestTrainMembershipPrimitives:
+
+  def test_injected_heartbeat_fault_is_absorbed(self, tmp_path,
+                                                monkeypatch):
+    """A transient error inside the membership pump's republish attempt
+    (injected at train.heartbeat) is absorbed: the next beat retries
+    and the counter keeps advancing for observers."""
+    from lddl_tpu.comm import HeartbeatPump
+    from lddl_tpu.core import faults
+    faults.reset()
+    monkeypatch.setenv('LDDL_FAULTS', 'raise:train.heartbeat:nth=1')
+    be = FileBackend(str(tmp_path), 0, 1, timeout=10.0, run_id='hb')
+    store = be.lease_store('train.membership')
+    pump = HeartbeatPump(store, 0.05, fault_site='train.heartbeat')
+    try:
+      t0 = time.monotonic()
+      while store.read_heartbeat(0) < 2 and time.monotonic() - t0 < 10.0:
+        time.sleep(0.05)
+      assert store.read_heartbeat(0) >= 2, \
+          'heartbeat counter stalled after the injected republish fault'
+    finally:
+      pump.stop()
+      faults.reset()
+
+  def test_shed_verdict_cas_unique(self, tmp_path):
+    """Both ranks score the same published signals; the shed verdict is
+    CAS-arbitrated, so exactly one record lands and every rank's poll()
+    obeys the record (not its own local computation)."""
+    from lddl_tpu.training.elastic import RankMembership
+    be0 = FileBackend(str(tmp_path), 0, 2, timeout=10.0, run_id='shed')
+    be1 = FileBackend(str(tmp_path), 1, 2, timeout=10.0, run_id='shed')
+    m0 = RankMembership(be0.lease_store('train.membership'), 0, 2,
+                        interval=0.1, timeout=30.0, shed_score=2.0).start()
+    m1 = RankMembership(be1.lease_store('train.membership'), 1, 2,
+                        interval=0.1, timeout=30.0, shed_score=2.0).start()
+    try:
+      m0.publish_signals({'steps_per_sec': 10.0})
+      m1.publish_signals({'steps_per_sec': 1.0})  # 5.5x the median: shed
+      assert m0.poll() == m1.poll() == 'shed:rank1'
+      fresh = be0.lease_store('train.membership')
+      assert fresh.list('shed.rank') == ['shed.rank1'], \
+          'the shed CAS must leave exactly one verdict record'
+    finally:
+      m0.stop()
+      m1.stop()
+
+
 class TestCommRetryAndKnobs:
 
   def test_injected_write_error_is_retried(self, tmp_path, monkeypatch):

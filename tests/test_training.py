@@ -26,14 +26,23 @@ CFG = BertConfig(
 )
 
 
-def _loop(shards, tiny_vocab, samples_seen=0):
+def _loop(shards, tiny_vocab, samples_seen=0, batch=8, dp_rank=None,
+          dp_world=None, mesh=None):
   tok = load_bert_tokenizer(vocab_file=tiny_vocab, backend='hf')
   return TrainLoop.build(
-      shards, tok, model_cfg=CFG, mesh=make_mesh(),
+      shards, tok, model_cfg=CFG,
+      mesh=mesh if mesh is not None else make_mesh(),
       learning_rate=1e-3, warmup_steps=2, total_steps=16,
-      batch_size_per_rank=8, bin_size=BIN_SIZE, max_seq_length=128,
+      batch_size_per_rank=batch, bin_size=BIN_SIZE, max_seq_length=128,
       seed=5, samples_seen=samples_seen,
-      loader_kwargs={'shuffle_buffer_size': 16})
+      loader_kwargs={'shuffle_buffer_size': 16},
+      dp_rank=dp_rank, dp_world=dp_world)
+
+
+def _assert_trees_equal(a, b):
+  jax.tree_util.tree_map(
+      lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                 np.asarray(y)), a, b)
 
 
 def test_checkpoint_resume_deterministic(shards, tiny_vocab, tmp_path):
@@ -103,6 +112,108 @@ def test_zero_batch_epoch_is_loud(shards, tiny_vocab):
       loader_kwargs={'shuffle_buffer_size': 16})
   with pytest.raises(ValueError, match='zero batches'):
     loop.run(4, log_every=0)
+
+
+def test_restore_world_size_resharding(shards, tiny_vocab, tmp_path):
+  """The resharding-resume contract: a checkpoint written at world size
+  1 restores onto a world-2 fleet (different mesh, halved per-rank
+  batch, constant global batch) with identical parameters on every
+  rank and an identical forward bin-draw sequence — the data position
+  (global samples_seen) is world-size-independent."""
+  import itertools
+  ckpt = str(tmp_path / 'ckpt')
+  first = _loop(shards, tiny_vocab)
+  first.run(4, ckpt_dir=ckpt, log_every=0)
+  assert TrainLoop.latest_meta(ckpt) == (4, 32)
+
+  w1 = _loop(shards, tiny_vocab, samples_seen=32).restore(ckpt)
+  # A genuinely different topology: half the devices, pure dp over 4.
+  half = np.asarray(jax.devices()[:4])
+  w2 = [
+      _loop(shards, tiny_vocab, samples_seen=32, batch=4, dp_rank=r,
+            dp_world=2, mesh=make_mesh(devices=half)).restore(ckpt)
+      for r in (0, 1)
+  ]
+  for loop in (w1, *w2):
+    assert loop.step == 4 and loop.samples_seen == 32
+    _assert_trees_equal(loop.params, first.params)
+
+  def bin_seq(loop, n=6):
+    return [b['input_ids'].shape[1]
+            for b in itertools.islice(iter(loop.loader), n)]
+
+  expect = bin_seq(w1)
+  assert [bin_seq(lp) for lp in w2] == [expect, expect]
+
+
+def test_sigterm_emergency_checkpoint(shards, tiny_vocab, tmp_path,
+                                      monkeypatch):
+  """A preemption notice (SIGTERM, injected with the 'term' fault
+  action) stops the loop at the next step boundary behind one final
+  complete synchronous checkpoint; the previous signal disposition is
+  restored afterwards."""
+  import signal
+
+  from lddl_tpu.core import faults
+  faults.reset()
+  monkeypatch.setenv('LDDL_FAULTS', 'term:train.step:nth=3')
+  before = signal.getsignal(signal.SIGTERM)
+  ckpt = str(tmp_path / 'ckpt')
+  loop = _loop(shards, tiny_vocab)
+  losses = loop.run(16, ckpt_dir=ckpt, log_every=0)
+  faults.reset()
+  assert signal.getsignal(signal.SIGTERM) == before
+  assert loop.stop_reason == 'preempted'
+  assert len(losses) == 3  # the step the signal landed on still ran
+  assert TrainLoop.latest_meta(ckpt) == (3, 24)
+  fresh = _loop(shards, tiny_vocab, samples_seen=24).restore(ckpt)
+  _assert_trees_equal(fresh.params, loop.params)
+
+
+def test_async_checkpoint_matches_sync(shards, tiny_vocab, tmp_path):
+  """The background checkpoint lane writes over a donation-safe
+  snapshot while later steps reuse (and invalidate) the donated
+  buffers; its checkpoints must be indistinguishable from synchronous
+  saves of the identical run."""
+  sync_dir, async_dir = str(tmp_path / 'sync'), str(tmp_path / 'async')
+  a = _loop(shards, tiny_vocab)
+  a.run(4, ckpt_dir=sync_dir, ckpt_every=2, log_every=0)
+  b = _loop(shards, tiny_vocab)
+  b.run(4, ckpt_dir=async_dir, ckpt_every=2, log_every=0, async_ckpt=True)
+  assert TrainLoop.latest_meta(async_dir) == TrainLoop.latest_meta(sync_dir)
+  ra = _loop(shards, tiny_vocab, samples_seen=32).restore(sync_dir)
+  rb = _loop(shards, tiny_vocab, samples_seen=32).restore(async_dir)
+  _assert_trees_equal(rb.params, ra.params)
+  _assert_trees_equal(rb.opt_state, ra.opt_state)
+
+
+def test_async_ckpt_failure_surfaces(shards, tiny_vocab, tmp_path,
+                                     monkeypatch):
+  """A checkpoint that dies on the writer thread must fail the run
+  (first-error-wins), never be silently dropped."""
+  from lddl_tpu.core import faults
+  from lddl_tpu.pipeline.pool import WriteBackError
+  faults.reset()
+  monkeypatch.setenv('LDDL_FAULTS', 'raise:train.ckpt:nth=1')
+  loop = _loop(shards, tiny_vocab)
+  with pytest.raises(WriteBackError):
+    loop.run(6, ckpt_dir=str(tmp_path / 'ckpt'), ckpt_every=2,
+             log_every=0, async_ckpt=True)
+  faults.reset()
+
+
+def test_latest_meta_skips_half_written_step(shards, tiny_vocab, tmp_path):
+  """A preemption can die between creating a step dir and committing
+  it; resume must fall back to the newest *readable* checkpoint (or
+  None) instead of raising."""
+  ckpt = tmp_path / 'ckpt'
+  loop = _loop(shards, tiny_vocab)
+  loop.run(2, ckpt_dir=str(ckpt), log_every=0)
+  (ckpt / '99').mkdir()  # the half-written newest step
+  assert TrainLoop.latest_meta(str(ckpt)) == (2, 16)
+  junk = tmp_path / 'junk'
+  (junk / '7').mkdir(parents=True)  # nothing readable at all
+  assert TrainLoop.latest_meta(str(junk)) is None
 
 
 def test_pretrain_cli_smoke(shards, tiny_vocab, tmp_path):
