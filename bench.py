@@ -240,6 +240,10 @@ def main():
         # views, fused native columnar shard assembly) — same
         # comparability rule as 'scheduler'.
         'transport': _resolve_transport(None),
+        # Endpoint of the network data service when transport=network
+        # (None otherwise): wire numbers are only comparable against
+        # other wire numbers, and the endpoint says whose wire it was.
+        'data_service': os.environ.get('LDDL_DATA_SERVER') or None,
         'zero_copy': _resolve_zero_copy(None),
         'native_columnar': native_columnar_enabled(),
         # Whether the LDDL_MONITOR live endpoint was serving during the

@@ -1,8 +1,10 @@
 """Loader feed-path throughput: ``num_workers x transport`` sweep.
 
-Measures batches/s and MB/s of the rank-local feed path under both
+Measures batches/s and MB/s of the rank-local feed path under the
 batch transports (``shm`` slot rings vs the classic ``mp.Queue``
-pickling handoff) at every requested worker count, in two modes:
+pickling handoff, plus one ``network`` wire cell served by an
+in-process ``lddl-data-server``) at every requested worker count, in
+two modes:
 
   - ``transport``: workers replay one precollated 64x512 batch
     (:class:`lddl_tpu.testing.SyntheticBatchLoader`), so the numbers
@@ -176,6 +178,33 @@ def _cell(mode, transport, W, make_iter, iters, warmup, tele_root):
   return cell
 
 
+def _network_cell(args, kwargs, tele_root):
+  """The wire column: an in-process ``lddl-data-server`` over the same
+  synthetic loader, drained by one persistent network-transport
+  ``MultiprocessLoader``. The loader must persist across ``_drain``'s
+  epochs — the server trims batches once acked, so a fresh client per
+  epoch would re-request an epoch with nothing left to re-serve."""
+  from lddl_tpu.loader.service import DataServer
+  from lddl_tpu.loader.workers import MultiprocessLoader
+  from lddl_tpu.testing import SyntheticBatchLoader
+  server = DataServer(SyntheticBatchLoader(**kwargs), window=16).start()
+  saved = os.environ.get('LDDL_DATA_SERVER')
+  os.environ['LDDL_DATA_SERVER'] = server.url
+  loader = MultiprocessLoader(
+      dict(kwargs), 0,
+      factory=('lddl_tpu.testing', 'get_synthetic_batch_loader'),
+      transport='network')
+  try:
+    return _cell('transport', 'network', 0, lambda epoch: iter(loader),
+                 args.iters, args.warmup, tele_root)
+  finally:
+    server.stop()
+    if saved is None:
+      os.environ.pop('LDDL_DATA_SERVER', None)
+    else:
+      os.environ['LDDL_DATA_SERVER'] = saved
+
+
 def _transport_cells(args, tele_root):
   from lddl_tpu.loader.shm import default_slot_bytes
   from lddl_tpu.loader.workers import MultiprocessLoader
@@ -187,6 +216,8 @@ def _transport_cells(args, tele_root):
                  lambda epoch: iter(SyntheticBatchLoader(**kwargs)),
                  args.iters, args.warmup, tele_root)]
   for transport in args.transports:
+    if transport == 'network':
+      continue  # one wire cell below; num_workers does not apply to it
     for W in args.workers:
       def make_iter(epoch, transport=transport, W=W):
         return iter(MultiprocessLoader(
@@ -197,6 +228,8 @@ def _transport_cells(args, tele_root):
                                           args.max_seq_length)))
       cells.append(_cell('transport', transport, W, make_iter, args.iters,
                          args.warmup, tele_root))
+  if 'network' in args.transports:
+    cells.append(_network_cell(args, kwargs, tele_root))
   return cells
 
 
@@ -237,6 +270,10 @@ def _e2e_cells(args, tele_root):
   cells = [_cell('e2e', 'serial', 0, make_iter, args.e2e_iters,
                  args.warmup, tele_root)]
   for transport in args.transports:
+    if transport == 'network':
+      continue  # e2e measures the worker handoff; the wire column is
+                # transport-mode only (a BERT-serving data server is a
+                # deployment, not a microbench)
     for W in args.workers:
       cells.append(_cell(
           'e2e', transport, W,
@@ -270,7 +307,10 @@ def main(argv=None):
   p.add_argument('--workers', default='1,2',
                  help='comma list of worker counts (0 serial baseline '
                       'always included)')
-  p.add_argument('--transports', default='pickle,shm')
+  p.add_argument('--transports', default='pickle,shm,network',
+                 help='comma list; "network" adds one wire cell served '
+                      'by an in-process lddl-data-server '
+                      '(transport mode only)')
   p.add_argument('--vocab-file', default=_DEFAULT_VOCAB)
   p.add_argument('--shard-dir', default=None,
                  help='reuse an existing balanced shard dir (e2e mode)')
@@ -301,6 +341,16 @@ def main(argv=None):
                       if any(c['mode'] == m for c in cells)},
       'telemetry_dir': tele_root,
   }
+  net = next((c['batches_per_sec'] for c in cells
+              if c['mode'] == 'transport' and c['transport'] == 'network'),
+             None)
+  pkl = [c['batches_per_sec'] for c in cells
+         if c['mode'] == 'transport' and c['transport'] == 'pickle']
+  if net is not None and pkl:
+    # The wire cell against the classic local pickling queue at its best
+    # worker count: >= 1.0 means pulling batches off a remote
+    # lddl-data-server costs no more than the local mp.Queue handoff.
+    summary['network_vs_pickle'] = round(net / max(pkl), 2)
   print(json.dumps(summary), flush=True)
   return {'cells': cells, 'summary': summary}
 

@@ -483,6 +483,67 @@ class SwallowedException(Rule):
 
 
 # ---------------------------------------------------------------------------
+# LDA012: socket without a deadline
+
+
+_SOCKET_CTORS = frozenset({'socket.socket'})
+_SOCKET_CONNECTORS = frozenset({'socket.create_connection'})
+
+
+class SocketWithoutDeadline(Rule):
+  rule_id = 'LDA012'
+  name = 'socket-without-deadline'
+  invariant = ('every socket carries a deadline before blocking use: an '
+               'unbounded accept/recv/connect turns one dead peer into '
+               'a hung rank the lease machinery cannot distinguish from '
+               'a slow one')
+  hint = ('call .settimeout(...) on the socket in the same scope, or '
+          'pass timeout= to socket.create_connection(...)')
+
+  def exempt(self, ctx):
+    # Tests open throwaway sockets (port probes, fake peers) whose
+    # lifetime the test harness itself bounds.
+    if ctx.path_is('tests/'):
+      return True
+    base = ctx.basename()
+    return (base.startswith('test_') or
+            base in ('conftest.py', 'testing.py'))
+
+  def begin_module(self, ctx):
+    scopes = [ctx.tree]
+    scopes.extend(
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    for scope in scopes:
+      nodes = list(_scope_nodes(scope))
+      # One-level scope discipline (same granularity as LDA003): a
+      # .settimeout(...) anywhere in the creating scope bounds every
+      # socket it creates; a socket handed to another function for its
+      # deadline would be flagged here, keeping the bound visible at
+      # the creation site.
+      has_deadline = any(
+          isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+          and n.func.attr == 'settimeout' for n in nodes)
+      if has_deadline:
+        continue
+      for n in nodes:
+        if not isinstance(n, ast.Call):
+          continue
+        dotted, _ = ctx.call_name(n)
+        if dotted in _SOCKET_CTORS:
+          yield self.finding(
+              n, 'socket.socket() created with no .settimeout(...) in '
+              'scope: its blocking calls can hang forever on a dead '
+              'peer', ctx)
+        elif dotted in _SOCKET_CONNECTORS and len(n.args) < 2 and not \
+            any(kw.arg == 'timeout' for kw in n.keywords):
+          yield self.finding(
+              n, 'socket.create_connection() without timeout= (and no '
+              '.settimeout(...) in scope): the connect can block '
+              'forever on an unreachable server', ctx)
+
+
+# ---------------------------------------------------------------------------
 # Project-mode (interprocedural) rules: LDA008–LDA011 run over the
 # whole-program call graph, not per file. Each finding carries the call
 # chain from the analysis root to the effect site.
@@ -654,6 +715,7 @@ def default_rules():
       RankConditionalCollective(),
       PoolChurn(),
       SwallowedException(),
+      SocketWithoutDeadline(),
   ]
 
 

@@ -27,6 +27,10 @@ fork's CodeBERT wrapper), all thin delegates:
   lddl_perf                      -> lddl_tpu.telemetry.perf (robust
                                     perf-regression gate over bench
                                     history; --gate for CI)
+  lddl_data_server               -> lddl_tpu.loader.service (fault-
+                                    tolerant network batch service:
+                                    serve one loader's deterministic
+                                    stream to N lease-claiming clients)
 
 Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
 installed console scripts.
@@ -120,6 +124,11 @@ def lddl_perf(args=None):
   return main(args)
 
 
+def lddl_data_server(args=None):
+  from .loader.service import main
+  return main(args)
+
+
 _COMMANDS = {
     'download_wikipedia': download_wikipedia,
     'download_books': download_books,
@@ -144,6 +153,8 @@ _COMMANDS = {
     'lddl-monitor': lddl_monitor,  # dash-form alias
     'lddl_perf': lddl_perf,
     'lddl-perf': lddl_perf,  # dash-form alias
+    'lddl_data_server': lddl_data_server,
+    'lddl-data-server': lddl_data_server,  # dash-form alias
 }
 
 

@@ -20,6 +20,7 @@ reference's "N local processes" test pattern).
 
 import os
 import pickle
+import random
 import tempfile
 import threading
 import time
@@ -68,6 +69,63 @@ def _retry_io(fn, what, retries=3, base_delay=0.01):
         raise
       get_telemetry().counter('comm.io_retries').add(1)
       time.sleep(base_delay * (2 ** attempt))
+
+
+def jitter_source(seed=None):
+  """A dedicated, seeded ``random.Random`` for retry jitter.
+
+  Backoff jitter must never touch the global RNG (data order is
+  deterministic by contract) and must still differ across processes so
+  a thundering herd decorrelates — seeding from the pid gives both.
+  """
+  return random.Random(os.getpid() if seed is None else seed)
+
+
+def backoff_delay(attempt, base=0.05, cap=2.0, jitter=None):
+  """Exponential backoff delay for retry ``attempt`` (0-based), capped,
+  with optional multiplicative jitter in [0.5, 1.5) drawn from a
+  :func:`jitter_source`. Jitter changes only retry *timing* — every
+  delay stays within [0.5 * base, 1.5 * cap] — never any result."""
+  delay = min(cap, base * (2 ** attempt))
+  if jitter is not None:
+    delay *= 0.5 + jitter.random()
+  return delay
+
+
+class LeaseStaleness:
+  """The fleet-wide lease-revocation verdict, factored for every lease
+  consumer (the elastic executor's ``_LeaseClaimer``, the data
+  service's ``_ServeClaimer``).
+
+  An owner is stale when the substrate proves it dead (pid beacon) or
+  its heartbeat *counter* has not moved for the lease timeout measured
+  on the observer's own monotonic clock — counters, not timestamps, so
+  cross-host clock skew can never manufacture a revocation.
+  """
+
+  def __init__(self, store, timeout):
+    self._store = store
+    self._timeout = timeout
+    self._hb_seen = {}  # owner -> (counter value, monotonic when it changed)
+
+  def stale(self, owner):
+    if self._store.owner_dead(owner):
+      return True  # positive death signal: no need to wait out the lease
+    hb = self._store.read_heartbeat(owner)
+    now = time.monotonic()
+    prev = self._hb_seen.get(owner)
+    if prev is None or prev[0] != hb:
+      self._hb_seen[owner] = (hb, now)
+      return False
+    # lddl: noqa[LDA003] lease staleness: survivors revoke only on a
+    # heartbeat counter silent past the lease timeout (or the positive
+    # death probe above). Racing observers converge on the same verdict
+    # via the revoke CAS, and re-execution is idempotent — outputs are
+    # f(task, global_index) behind atomic renames — so clock skew can
+    # cost duplicated work, never divergent bytes.
+    if now - prev[1] > self._timeout:
+      return True
+    return False
 
 
 class CommBackend:

@@ -34,9 +34,15 @@ atomic write), ``train.step`` (train-loop step entry, after the batch
 is pulled), ``train.ckpt`` (checkpoint write entry — fires on the
 background writer thread under ``LDDL_ASYNC_CKPT``, so raise-specs
 exercise the first-error-wins surfacing), ``train.heartbeat`` (the
-train membership pump's republish attempt). ``inject()`` is a no-op
-(one env read) when ``LDDL_FAULTS`` is unset, so production paths pay
-nothing measurable.
+train membership pump's republish attempt), ``serve.accept`` (data
+server, per accepted client connection), ``serve.batch`` (data server
+producer, per packed batch — ``gi`` filterable), ``client.pull``
+(network batch client, before each batch request — ``gi`` filterable;
+kill-specs here are how the dead-consumer re-serve tests drop a client
+cleanly between batches), ``wire.write`` (every data-service frame
+send, both ends — raise-specs break the wire mid-stream). ``inject()``
+is a no-op (one env read) when ``LDDL_FAULTS`` is unset, so production
+paths pay nothing measurable.
 """
 
 import os

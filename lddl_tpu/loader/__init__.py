@@ -27,16 +27,26 @@ __all__ = [
     'SeqlenAwarePrefetcher',
     'make_global_batch',
     'prefetch_to_device',
+    'DataServer',
+    'NetworkBatchSource',
+    'discover_data_servers',
 ]
 
 _DEVICE_EXPORTS = ('SeqlenAwarePrefetcher', 'make_global_batch',
                    'prefetch_to_device')
+_SERVICE_EXPORTS = ('DataServer', 'NetworkBatchSource',
+                    'discover_data_servers')
 
 
 def __getattr__(name):
   # Lazy: .device imports jax, which the host-only loader paths (and the
-  # preprocess pool workers that import this package) must not pay for.
+  # preprocess pool workers that import this package) must not pay for;
+  # .service stays lazy symmetrically (only network-transport users pay
+  # its socket/announce machinery).
   if name in _DEVICE_EXPORTS:
     from . import device
     return getattr(device, name)
+  if name in _SERVICE_EXPORTS:
+    from . import service
+    return getattr(service, name)
   raise AttributeError(name)

@@ -35,8 +35,12 @@ Batch transport (``transport=`` / ``LDDL_LOADER_TRANSPORT``):
     ``list(loader)`` does not.
   - ``'pickle'``: the classic ``mp.Queue`` handoff (full pickle + pipe
     crossing per batch) — kept for comparison and exotic batch payloads.
+  - ``'network'``: pull batches from an ``lddl-data-server``
+    (:mod:`.service`) over TCP instead of spawning collate workers —
+    the same packed spec the shm slots carry, with lease-based
+    multi-client drain and a degraded-mode local fallback.
 
-Both transports deliver byte-identical batches; a batch that does not
+All transports deliver byte-identical batches; a batch that does not
 fit its shm slot silently falls back to pickling for that step.
 """
 
@@ -74,8 +78,9 @@ def _mp_context():
 def _resolve_transport(transport):
   t = (transport or os.environ.get(_TRANSPORT_ENV, '').strip().lower()
        or 'shm')
-  if t not in ('shm', 'pickle'):
-    raise ValueError(f'unknown loader transport {t!r} (shm|pickle)')
+  if t not in ('shm', 'pickle', 'network'):
+    raise ValueError(
+        f'unknown loader transport {t!r} (shm|pickle|network)')
   return t
 
 
@@ -210,6 +215,10 @@ class MultiprocessLoader:
           'object does not pickle)')
     self._factory = tuple(factory)
     self._kwargs = dict(build_kwargs)
+    # The network transport's lease-based multi-client drain needs the
+    # rank's real comm backend (for lease_store('serve')); capture it
+    # before the worker-side NullBackend substitution below.
+    self._client_comm = build_kwargs.get('comm')
     # Workers must NOT participate in comm collectives: they would rejoin
     # the world as duplicate ranks and corrupt the real ranks' collective
     # sequence. An explicit NullBackend (not None — build_pretrain_loader
@@ -221,6 +230,7 @@ class MultiprocessLoader:
     self._transport = _resolve_transport(transport)
     self._queue_depth = _resolve_queue_depth(queue_depth)
     self._zero_copy = _resolve_zero_copy(zero_copy)
+    self._net_source = None  # lazy NetworkBatchSource (network transport)
     self._serial = _resolve_factory(self._factory)(**build_kwargs)
     if slot_bytes is None:
       slot_bytes = default_slot_bytes(
@@ -273,7 +283,29 @@ class MultiprocessLoader:
               f'loader worker {w} died without reporting '
               f'(exitcode {proc.exitcode})')
 
+  def _iter_network(self):
+    """``transport='network'``: pull the epoch from a data server
+    (:mod:`.service`) instead of spawning collate workers — the server
+    already collated once for the whole fleet. Same epoch/resume
+    contract as the process path; the serial loader still tracks
+    position, so a degraded client (or the next epoch) resumes at the
+    exact deterministic step."""
+    from .service import NetworkBatchSource
+    epoch = self._serial.epoch
+    first_step = self._serial._batches_consumed
+    self._serial._batches_consumed = 0
+    if self._net_source is None:
+      self._net_source = NetworkBatchSource(
+          build_kwargs=self._kwargs, factory=self._factory,
+          comm=self._client_comm)
+    for _, batch in self._net_source.iter_steps(epoch, first_step):
+      yield batch
+    self._serial.epoch = epoch + 1
+
   def __iter__(self):
+    if self._transport == 'network':
+      yield from self._iter_network()
+      return
     epoch = self._serial.epoch
     first_step = self._serial._batches_consumed
     clear_consumed = first_step == 0

@@ -31,7 +31,8 @@ import threading
 import time
 import weakref
 
-from ..comm import HeartbeatPump, NullBackend, comm_heartbeat_interval
+from ..comm import (HeartbeatPump, LeaseStaleness, NullBackend,
+                    comm_heartbeat_interval)
 from ..core import faults
 from ..telemetry import get_telemetry
 from ..telemetry.server import maybe_start_monitor
@@ -137,12 +138,12 @@ class _LeaseClaimer:
   def __init__(self, store, order, timeout=None, telemetry=None):
     self._store = store
     self._order = list(order)
-    self._timeout = lease_timeout() if timeout is None else timeout
+    self._staleness = LeaseStaleness(
+        store, lease_timeout() if timeout is None else timeout)
     self._done = set()
     self._mine = set()  # claims this rank won (executed this incarnation)
     self._gen = {}  # gi -> live claim generation
     self._foreign = {}  # (gi, gen) -> owning rank (immutable once read)
-    self._hb_seen = {}  # owner -> (counter value, monotonic when it changed)
     tele = telemetry if telemetry is not None else get_telemetry()
     self._claims = tele.counter('pipeline.elastic.claims')
     self._reexecutions = tele.counter('pipeline.elastic.reexecutions')
@@ -222,23 +223,9 @@ class _LeaseClaimer:
     return progressed
 
   def _owner_stale(self, owner):
-    if self._store.owner_dead(owner):
-      return True  # positive death signal: no need to wait out the lease
-    hb = self._store.read_heartbeat(owner)
-    now = time.monotonic()
-    prev = self._hb_seen.get(owner)
-    if prev is None or prev[0] != hb:
-      self._hb_seen[owner] = (hb, now)
-      return False
-    # lddl: noqa[LDA003] lease staleness: survivors revoke only on a
-    # heartbeat counter silent past the lease timeout (or the positive
-    # death probe above). Racing observers converge on the same verdict
-    # via the revoke CAS, and re-execution is idempotent — outputs are
-    # f(task, global_index) behind atomic renames — so clock skew can
-    # cost duplicated work, never divergent bytes.
-    if now - prev[1] > self._timeout:
-      return True
-    return False
+    # Shared verdict (positive pid death OR heartbeat counter silent
+    # past the lease timeout on our own clock): see LeaseStaleness.
+    return self._staleness.stale(owner)
 
 
 def _run_task(fn, global_index, task):

@@ -129,12 +129,41 @@ def live_verdict(window):
   merged, sec = _merged_delta(window)
   if merged is None:
     return {'stages': {}, 'bottleneck': 'unknown (window warming up)',
-            'detail': '', 'window_sec': 0.0, 'roofline': None}
+            'detail': '', 'window_sec': 0.0, 'roofline': None,
+            'serve': None}
   verdict = summarize_stages(merged)
   verdict['window_sec'] = sec
   from .roofline import roofline_verdict
   verdict['roofline'] = roofline_verdict(merged, sec)
+  verdict['serve'] = serve_verdict(merged, sec)
   return verdict
+
+
+def serve_verdict(merged, sec):
+  """Data-service sub-verdict over a windowed delta: delivery rate plus
+  the fault-churn counters (re-serves to recovering clients, lease
+  revocations of dead ones, degrade/re-attach transitions). None when
+  the window saw no ``serve.*`` activity — quiet dashboards for the
+  overwhelming majority of runs that never serve over the wire."""
+  metrics = merged['metrics']
+  served = _counter_total(metrics, 'serve.batches_served')
+  pulls = _counter_total(metrics, 'serve.client_pulls')
+  meters = {
+      'batches_served': served,
+      'batches_per_sec': served / sec if sec > 0 else None,
+      'client_pulls': pulls,
+      'reserves': _counter_total(metrics, 'serve.reserves'),
+      'lease_revokes': _counter_total(metrics, 'serve.lease_revokes'),
+      'fallbacks': _counter_total(metrics, 'serve.fallbacks'),
+      'reattaches': _counter_total(metrics, 'serve.reattaches'),
+      'clients': _gauge(metrics, 'serve.clients'),
+      'backlog': _gauge(metrics, 'serve.backlog'),
+  }
+  active = (served or pulls or meters['reserves'] or
+            meters['lease_revokes'] or meters['fallbacks'] or
+            meters['reattaches'] or meters['clients'] is not None or
+            meters['backlog'] is not None)
+  return meters if active else None
 
 
 # ---------------------------------------------------------------------------
@@ -264,6 +293,26 @@ def goodput_meters(merged):
       'async_ckpt_writes': _counter_total(metrics, 'train.ckpt_writes'),
   }
   out['fault_tolerance'] = ft if any(ft.values()) else None
+
+  # Data-service meters (lddl-data-server / network transport clients):
+  # delivery volume, the re-serve/revoke churn dead consumers cause, and
+  # the degraded-mode transitions. None when this process neither serves
+  # nor pulls batches over the wire.
+  serve = {
+      'batches_served': _counter_total(metrics, 'serve.batches_served'),
+      'reserves': _counter_total(metrics, 'serve.reserves'),
+      'lease_claims': _counter_total(metrics, 'serve.lease_claims'),
+      'lease_revokes': _counter_total(metrics, 'serve.lease_revokes'),
+      'client_pulls': _counter_total(metrics, 'serve.client_pulls'),
+      'fallbacks': _counter_total(metrics, 'serve.fallbacks'),
+      'reattaches': _counter_total(metrics, 'serve.reattaches'),
+      'clients': _gauge(metrics, 'serve.clients'),
+      'backlog': _gauge(metrics, 'serve.backlog'),
+  }
+  instrumented = (serve['clients'] is not None or
+                  serve['backlog'] is not None or
+                  any(isinstance(v, int) and v for v in serve.values()))
+  out['serve'] = serve if instrumented else None
   return out
 
 

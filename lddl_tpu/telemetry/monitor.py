@@ -101,6 +101,16 @@ def discover_announcements(directory):
   return out
 
 
+def discover_serve_announcements(directory):
+  """Parsed ``serve.pid*.json`` data-server announces under
+  ``directory``, each with a ``dead`` flag from the same positive-death
+  pid probe the monitor announces use. Lets the dashboard list live
+  ``lddl-data-server`` endpoints next to rank endpoints and fold a
+  SIGKILLed server into the error list instead of connection noise."""
+  from ..loader.service import discover_data_servers
+  return discover_data_servers(directory)
+
+
 def discover_endpoints(directory, include_dead=False):
   """Endpoint URLs from announce files under ``directory``, rank order.
 
@@ -157,6 +167,10 @@ def render_frame(fleet, clear=True):
     out.append('\x1b[2J\x1b[H')
   out.append('lddl-monitor · %d rank(s) · %s' %
              (len(fleet['ranks']), time.strftime('%H:%M:%S')))
+  for info in fleet.get('data_servers') or []:
+    if not info.get('dead'):
+      out.append(f'  data-server {info.get("url")} '
+                 f'(pid {info.get("pid")})')
   for url, err in sorted(fleet['errors'].items()):
     out.append(f'  !! {url}: {err}')
   for rank in sorted(fleet['ranks']):
@@ -227,6 +241,22 @@ def render_frame(fleet, clear=True):
     if ft:
       parts = [f'{k.replace("_", "-")} {v}' for k, v in ft.items() if v]
       out.append('  fault-tolerance: ' + ' · '.join(parts))
+    srv = verdict.get('serve')
+    if srv:
+      line = '  serve:'
+      if srv.get('clients') is not None:
+        line += f' {srv["clients"]["mean"]:.0f} client(s)'
+      if srv.get('batches_per_sec') is not None:
+        line += f' · {_fmt_rate(srv["batches_per_sec"])} batches/s'
+      for label, key in (('re-serves', 'reserves'),
+                         ('lease-revokes', 'lease_revokes'),
+                         ('fallbacks', 'fallbacks'),
+                         ('re-attaches', 'reattaches')):
+        if srv.get(key):
+          line += f' · {label} {srv[key]}'
+      if srv.get('backlog') is not None:
+        line += f' · backlog {srv["backlog"]["mean"]:.1f}'
+      out.append(line)
   strag = fleet.get('straggler')
   if strag:
     out.append('')
@@ -281,6 +311,16 @@ def main(args=None):
                                '(stale announce file); skipped')
         elif info['url'] not in urls:
           urls.append(info['url'])
+      # Data-server announces: live ones are listed in the frame header
+      # (their own monitor endpoint, if any, rides the monitor.rank*
+      # announce above); a SIGKILLed server's stale announce becomes a
+      # fleet error instead of every client's connection noise.
+      for info in discover_serve_announcements(args.dir):
+        if info['dead']:
+          dead[f'data-server {info["url"]}'] = (
+              f'data server pid {info.get("pid")} is dead '
+              '(stale serve announce); clients will degrade to their '
+              'local loaders')
     return urls, dead
 
   if args.profile is not None:
@@ -313,6 +353,8 @@ def main(args=None):
       return 2
     fleet = poll_fleet(urls, timeout=args.timeout)
     fleet['errors'].update(dead)
+    if args.dir:
+      fleet['data_servers'] = discover_serve_announcements(args.dir)
     if args.once:
       if args.json:
         print(json.dumps(fleet, default=str, indent=2))

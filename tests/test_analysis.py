@@ -427,6 +427,91 @@ def test_lda007_exempts_tests_and_testing():
 
 
 # ---------------------------------------------------------------------------
+# LDA012: socket without a deadline
+
+
+def test_lda012_flags_socket_without_settimeout():
+  assert run("""
+      import socket
+      def serve():
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.bind(addr)
+        srv.listen()
+        return srv.accept()
+      """) == ['LDA012']
+
+
+def test_lda012_clean_with_settimeout_in_scope():
+  assert run("""
+      import socket
+      def serve():
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.settimeout(0.5)
+        srv.bind(addr)
+        return srv.accept()
+      """) == []
+
+
+def test_lda012_flags_create_connection_without_timeout():
+  assert run("""
+      import socket
+      def connect(addr):
+        return socket.create_connection(addr)
+      """) == ['LDA012']
+
+
+def test_lda012_clean_create_connection_with_timeout():
+  assert run("""
+      import socket
+      def connect(addr, deadline):
+        return socket.create_connection(addr, timeout=deadline)
+      """) == []
+  # Positional timeout (second arg) also counts.
+  assert run("""
+      import socket
+      def connect(addr, deadline):
+        return socket.create_connection(addr, deadline)
+      """) == []
+
+
+def test_lda012_scope_is_per_function():
+  # A settimeout in one function does not bless a socket created in
+  # another: the deadline must be visible at the creation scope.
+  assert run("""
+      import socket
+      def a():
+        s = socket.socket()
+        return s
+      def b(s):
+        s.settimeout(1.0)
+      """) == ['LDA012']
+
+
+def test_lda012_pragma_suppresses():
+  findings = run_findings("""
+      import socket
+      def serve():
+        # lddl: noqa[LDA012] lifetime bounded by the caller's deadline
+        srv = socket.socket()
+        return srv
+      """)
+  assert [f.rule_id for f in findings] == ['LDA012']
+  assert findings[0].suppressed
+
+
+def test_lda012_exempts_tests_and_testing():
+  src = """
+      import socket
+      def probe():
+        s = socket.socket()
+        return s
+      """
+  assert run(src, path='tests/test_something.py') == []
+  assert run(src, path='lddl_tpu/testing.py') == []
+  assert run(src) == ['LDA012']
+
+
+# ---------------------------------------------------------------------------
 # Engine / pragmas / CLI
 
 
