@@ -104,6 +104,20 @@ def _lint_status():
     return {}
 
 
+def _ledger_enabled():
+  """Whether the determinism ledger will fingerprint this run's batches.
+
+  Resolved through :func:`get_ledger` (not a raw env check) so the stamp
+  reflects the same gate the pipeline consults — including programmatic
+  ``enable_ledger()`` use that never touches ``LDDL_LEDGER``.
+  """
+  try:
+    from lddl_tpu.telemetry.ledger import get_ledger
+    return get_ledger().enabled
+  except Exception:
+    return False
+
+
 def _reference_style_partition(lines, hf_tok, vocab_words, seed,
                                duplicate_factor=5):
   """The reference's per-partition hot loop, reimplemented faithfully:
@@ -250,6 +264,11 @@ def main():
         # measurement (its thread shares the host CPU with the pipeline).
         'monitor': os.environ.get('LDDL_MONITOR', '') not in
                    ('', '0', 'false', 'off', 'no'),
+        # Whether the determinism ledger was fingerprinting batches during
+        # the measurement (per-batch xxh64/blake2b + O_APPEND write — see
+        # PERF.md "Determinism ledger overhead"). A BENCH line captured
+        # with the ledger on is not comparable against one with it off.
+        'ledger': _ledger_enabled(),
         # Attention masking regime of the training stack this build feeds:
         # 'full' (whole packed row attends to itself) vs 'block_diagonal'
         # (per-doc segment ids, cross-doc tiles skipped) — LDDL_BENCH_

@@ -544,6 +544,132 @@ class SocketWithoutDeadline(Rule):
 
 
 # ---------------------------------------------------------------------------
+# LDA013: salted builtin hash() escaping the process
+
+
+# Attribute-call terminals through which a value leaves the process (or
+# the run): file/socket writes, queue handoffs, serialization, wire
+# packing, and the determinism ledger itself.
+_HASH_SINKS = frozenset({
+    'write', 'writelines', 'send', 'sendall', 'sendto', 'put',
+    'put_nowait', 'dump', 'dumps', 'pack', 'pack_into', 'publish',
+    'record',
+})
+
+
+def _builtin_hash_call(node, ctx):
+  """The first builtin ``hash(...)`` call whose *value* escapes through
+  ``node``, or None. Comparison/boolean subtrees are pruned: the result
+  of ``hash(a) == hash(b)`` computed in one interpreter is the same for
+  every salt, so only the raw hash value carries the hazard. Alias
+  resolution keeps a local/imported ``hash`` name out."""
+  stack = [node]
+  while stack:
+    n = stack.pop()
+    if isinstance(n, (ast.Compare, ast.BoolOp)):
+      continue  # boolean results are salt-invariant
+    if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and
+        ctx.call_name(n)[0] == 'hash'):
+      return n
+    stack.extend(ast.iter_child_nodes(n))
+  return None
+
+
+class SaltedHashEscape(Rule):
+  rule_id = 'LDA013'
+  name = 'salted-hash'
+  invariant = ('fingerprints that cross a process or run boundary come '
+               'from a stable hash: builtin hash() on str/bytes is '
+               'salted per interpreter (PYTHONHASHSEED), so a persisted '
+               'or sent value never matches the next run or another rank')
+  hint = ('use hashlib (blake2b/sha256) or the telemetry.ledger '
+          'fingerprint helpers for anything written, sent, or used for '
+          'placement; builtin hash() is only meaningful inside one '
+          'process')
+
+  def exempt(self, ctx):
+    # Tests may assert on salted hashes within their own interpreter.
+    if ctx.path_is('tests/'):
+      return True
+    base = ctx.basename()
+    return (base.startswith('test_') or
+            base in ('conftest.py', 'testing.py'))
+
+  def _sink_of(self, node, ctx, in_hash_protocol):
+    """Human description of the escape ``node`` represents, or None.
+    Only the *payload* position of a call counts (its arguments):
+    ``hash_index.write(...)`` must not read as a hash sink."""
+    if isinstance(node, ast.Call):
+      _, term = ctx.call_name(node)
+      if term in _HASH_SINKS:
+        return f'{term}()', list(node.args) + [kw.value
+                                               for kw in node.keywords]
+      return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+      # hash(key) % n: placement/sharding — the classic cross-worker
+      # divergence — and "%s" % hash(x) stringification both land here.
+      return "a '%' placement/format expression", [node.left, node.right]
+    if isinstance(node, ast.Return) and node.value is not None \
+        and not in_hash_protocol:
+      # A returned hash escapes the one scope this analysis can see;
+      # __hash__ is the process-local protocol use and stays legal.
+      return 'a return (escapes this scope)', [node.value]
+    return None
+
+  def begin_module(self, ctx):
+    scopes = [ctx.tree]
+    scopes.extend(
+        n for n in ast.walk(ctx.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)))
+    for scope in scopes:
+      nodes = list(_scope_nodes(scope))
+      in_hash_protocol = (
+          isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)) and
+          scope.name == '__hash__')
+      tainted = set()
+      for n in nodes:
+        value = getattr(n, 'value', None)
+        if value is None:
+          continue
+        if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                          ast.NamedExpr)) and _builtin_hash_call(value,
+                                                                 ctx):
+          targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+          for t in targets:
+            tainted.update(_assigned_names(t))
+      seen = set()
+      for n in nodes:
+        sink = self._sink_of(n, ctx, in_hash_protocol)
+        if sink is None:
+          continue
+        what, payload = sink
+        for arg in payload:
+          call = _builtin_hash_call(arg, ctx)
+          if call is not None:
+            key = (call.lineno, call.col_offset)
+            if key not in seen:
+              seen.add(key)
+              yield self.finding(
+                  call, f'builtin hash() feeds {what}: hash() of '
+                  'str/bytes is salted per interpreter '
+                  '(PYTHONHASHSEED), so the value differs across runs '
+                  'and ranks', ctx)
+            continue
+          used = sorted(
+              x.id for x in ast.walk(arg)
+              if isinstance(x, ast.Name) and x.id in tainted)
+          if used:
+            key = (n.lineno, n.col_offset, used[0])
+            if key not in seen:
+              seen.add(key)
+              yield self.finding(
+                  n, f'{used[0]!r} (derived from builtin hash()) feeds '
+                  f'{what}: hash() of str/bytes is salted per '
+                  'interpreter (PYTHONHASHSEED), so the value differs '
+                  'across runs and ranks', ctx)
+
+
+# ---------------------------------------------------------------------------
 # Project-mode (interprocedural) rules: LDA008–LDA011 run over the
 # whole-program call graph, not per file. Each finding carries the call
 # chain from the analysis root to the effect site.
@@ -716,6 +842,7 @@ def default_rules():
       PoolChurn(),
       SwallowedException(),
       SocketWithoutDeadline(),
+      SaltedHashEscape(),
   ]
 
 

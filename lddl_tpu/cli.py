@@ -26,7 +26,12 @@ fork's CodeBERT wrapper), all thin delegates:
                                     stragglers, goodput)
   lddl_perf                      -> lddl_tpu.telemetry.perf (robust
                                     perf-regression gate over bench
-                                    history; --gate for CI)
+                                    history; --gate for CI, --audit
+                                    folds determinism verification in)
+  lddl_audit                     -> lddl_tpu.telemetry.audit (diff/
+                                    verify determinism ledgers; bisects
+                                    the first divergent batch/step,
+                                    exits nonzero for CI)
   lddl_data_server               -> lddl_tpu.loader.service (fault-
                                     tolerant network batch service:
                                     serve one loader's deterministic
@@ -124,6 +129,11 @@ def lddl_perf(args=None):
   return main(args)
 
 
+def lddl_audit(args=None):
+  from .telemetry.audit import main
+  return main(args)
+
+
 def lddl_data_server(args=None):
   from .loader.service import main
   return main(args)
@@ -153,6 +163,8 @@ _COMMANDS = {
     'lddl-monitor': lddl_monitor,  # dash-form alias
     'lddl_perf': lddl_perf,
     'lddl-perf': lddl_perf,  # dash-form alias
+    'lddl_audit': lddl_audit,
+    'lddl-audit': lddl_audit,  # dash-form alias
     'lddl_data_server': lddl_data_server,
     'lddl-data-server': lddl_data_server,  # dash-form alias
 }

@@ -19,6 +19,9 @@ independently)::
                     emergency-checkpoint hook is tested with)
             raise   raise OSError('injected fault ...')
             delay   sleep ``sec`` seconds (default 0.1)
+            corrupt flip a byte in the caller-supplied buffer — only
+                    fires through :func:`corrupt_bytes`, never
+                    :func:`inject` (it needs the data in hand)
   filters:  rank=R  only when the caller passes rank=R
             gi=N    only when the caller passes gi=N
             nth=K   only on the K-th matching hit in this process (1-based)
@@ -27,6 +30,7 @@ independently)::
                     not re-trip the same fault (the resume tests need
                     exactly this)
   extras:   sec=S   delay duration
+            at=I    corrupt: byte index to flip (default 0)
 
 Instrumented sites: ``elastic.task`` (executor lease-claimed task entry),
 ``pool.task`` (pool worker task entry), ``comm.write`` (FileBackend
@@ -40,9 +44,12 @@ producer, per packed batch — ``gi`` filterable), ``client.pull``
 (network batch client, before each batch request — ``gi`` filterable;
 kill-specs here are how the dead-consumer re-serve tests drop a client
 cleanly between batches), ``wire.write`` (every data-service frame
-send, both ends — raise-specs break the wire mid-stream). ``inject()``
-is a no-op (one env read) when ``LDDL_FAULTS`` is unset, so production
-paths pay nothing measurable.
+send, both ends — raise-specs break the wire mid-stream),
+``ledger.corrupt`` (:func:`corrupt_bytes` on a packed batch after the
+producer hashed it — loader parent and data-service server — the
+silent-data-corruption drill the determinism ledger's auditor is
+proven against). ``inject()`` is a no-op (one env read) when
+``LDDL_FAULTS`` is unset, so production paths pay nothing measurable.
 """
 
 import os
@@ -84,10 +91,17 @@ def _fire(action, site, opts):
   raise ValueError(f'unknown fault action {action!r}')
 
 
-def _maybe_fire(spec, site, ctx):
+def _match(spec, site, ctx):
+  """Parse ``spec`` and apply its site + filter gates against this
+  invocation; returns ``(action, opts)`` when the fault should fire,
+  else None. Shared by :func:`inject` (process-level actions) and
+  :func:`corrupt_bytes` (the one action that needs the caller's data
+  in hand). Counts and once-markers are claimed here, so a matching
+  spec fires exactly as often whichever entry point queried it.
+  """
   fields = spec.split(':')
   if len(fields) < 2 or fields[1] != site:
-    return
+    return None
   action = fields[0]
   opts = {}
   for kv in (fields[2].split(',') if len(fields) > 2 else ()):
@@ -95,10 +109,10 @@ def _maybe_fire(spec, site, ctx):
     opts[k] = v
   for key in ('rank', 'gi'):
     if key in opts and str(ctx.get(key)) != opts[key]:
-      return
+      return None
   _counts[spec] = _counts.get(spec, 0) + 1
   if 'nth' in opts and _counts[spec] != int(opts['nth']):
-    return
+    return None
   if 'once' in opts:
     marker = _once_marker(spec)
     if not os.environ.get('LDDL_FAULTS_DIR'):
@@ -109,8 +123,8 @@ def _maybe_fire(spec, site, ctx):
       fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
       os.close(fd)
     except FileExistsError:
-      return
-  _fire(action, site, opts)
+      return None
+  return action, opts
 
 
 def inject(site, **ctx):
@@ -118,12 +132,46 @@ def inject(site, **ctx):
 
   Call at the top of a recoverable operation, passing whatever identity
   the filters should see (``gi=``, ``rank=``). No-op when ``LDDL_FAULTS``
-  is unset.
+  is unset. ``corrupt`` specs are ignored here — they fire only through
+  :func:`corrupt_bytes`, which has the buffer to damage.
   """
   specs = os.environ.get('LDDL_FAULTS', '')
   if not specs:
     return
   for spec in specs.split(';'):
     spec = spec.strip()
-    if spec:
-      _maybe_fire(spec, site, ctx)
+    if not spec or spec.startswith('corrupt:'):
+      continue
+    hit = _match(spec, site, ctx)
+    if hit is not None:
+      _fire(hit[0], site, hit[1])
+
+
+def corrupt_bytes(site, buf, **ctx):
+  """Flip one byte of ``buf`` when a ``corrupt:<site>`` spec matches —
+  the silent-data-corruption drill for the determinism ledger.
+
+  ``buf`` is any writable buffer-protocol object (a shm slot window, an
+  ndarray's ``.data``); byte ``at`` (default 0, modulo the buffer
+  length) is XORed with 0xFF. Same filters as :func:`inject`
+  (``rank=``/``gi=``/``nth=``/``once``), same no-op-when-unset cost.
+  Returns True when the buffer was damaged, so call sites can log the
+  deed to the test.
+  """
+  specs = os.environ.get('LDDL_FAULTS', '')
+  if not specs:
+    return False
+  hit = False
+  for spec in specs.split(';'):
+    spec = spec.strip()
+    if not spec or not spec.startswith('corrupt:'):
+      continue
+    m = _match(spec, site, ctx)
+    if m is None:
+      continue
+    mv = memoryview(buf).cast('B')
+    if len(mv):
+      i = int(m[1].get('at', '0')) % len(mv)
+      mv[i] ^= 0xFF
+      hit = True
+  return hit

@@ -331,7 +331,25 @@ class BertPretrainLoader:
     self.epoch += 1
 
   def __iter__(self):
-    for _, batch in self.iter_steps():
+    # The collate boundary for serial consumption (num_workers=0):
+    # fingerprint each batch in delivery order, keyed (epoch, index) —
+    # the exact coordinates a resumed run replays. The multiprocess /
+    # network paths record the same boundary at their own delivery
+    # points (workers.py), never here: workers iterate iter_steps()
+    # directly, so no batch is ever double-recorded.
+    from ..core import faults
+    from ..telemetry.ledger import (
+        fingerprint_batch, first_ndarray, get_ledger)
+    ledger = get_ledger()
+    epoch = self.epoch
+    for step, batch in self.iter_steps():
+      if ledger.enabled:
+        arr = first_ndarray(batch)
+        if arr is not None:
+          faults.corrupt_bytes('ledger.corrupt', arr.data,
+                               rank=ledger.rank, epoch=epoch, index=step)
+        ledger.record('collate', fingerprint_batch(batch), epoch=epoch,
+                      index=step)
       yield batch
 
 

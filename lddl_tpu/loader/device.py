@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
 from ..telemetry import get_telemetry
+from ..telemetry.ledger import fingerprint_batch, get_ledger
 from ..telemetry.trace import get_tracer
 
 _DEFAULT_MESH = None
@@ -169,9 +170,19 @@ def prefetch_to_device(iterator, mesh=None, data_axis=None, seq_axis=None,
       live_bytes_g.set(sum(live_sizes.values()))
       live_batches_g.set(len(live_sizes))
 
+  ledger = get_ledger()
+  feed_index = 0
+
   def _producer():
+    nonlocal feed_index
     try:
       for item in iterator:
+        if ledger.enabled:
+          # The device boundary: the last stop where the batch is still
+          # host bytes. Hashed on the producer thread, so the cost
+          # overlaps the main thread's compute like the transfer does.
+          ledger.record('device', fingerprint_batch(item), index=feed_index)
+        feed_index += 1
         # The host-to-device transfer phase, on the producer thread's
         # own trace lane (overlaps the main thread's compute span).
         with tracer.span('train.h2d'), h2d_hist.time():

@@ -68,6 +68,8 @@ from ..comm.backend import (HeartbeatPump, backoff_delay,
 from ..core import faults
 from ..core.log import warn_once
 from ..telemetry import get_telemetry
+from ..telemetry.ledger import (first_array_span, fingerprint_packed,
+                                get_ledger)
 from .shm import SlotOverflow, _pack_into, _unpack_from
 
 _MAGIC = b'LDS1'
@@ -391,6 +393,7 @@ class DataServer:
 
   def _produce(self):
     try:
+      ledger = get_ledger()
       epoch = int(getattr(self._loader, 'epoch', 0))
       remaining = self._epochs
       while not self._stop.is_set():
@@ -401,6 +404,23 @@ class DataServer:
         for step, batch in self._loader.iter_steps((0, 1)):
           faults.inject('serve.batch', gi=step)
           spec, payload = pack_batch(batch)
+          if ledger.enabled:
+            # serve.tx: what the server *intends* to send, hashed once
+            # at pack time (re-serves repeat the same payload). The
+            # corrupt drill below fires only after this record, so a
+            # damaged frame shows up as tx != rx — exactly the
+            # silent-corruption signature the auditor looks for.
+            ledger.record('serve.tx', fingerprint_packed(spec, payload),
+                          epoch=epoch, gi=step)
+          if 'corrupt:' in os.environ.get('LDDL_FAULTS', ''):
+            span = first_array_span(spec)
+            if span is not None:
+              damaged = bytearray(payload)
+              if faults.corrupt_bytes(
+                  'ledger.corrupt',
+                  memoryview(damaged)[span[0]:span[0] + span[1]],
+                  gi=step, epoch=epoch):
+                payload = bytes(damaged)
           with self._lock:
             while (len(self._buf) >= self._window and
                    not self._stop.is_set()):
@@ -715,6 +735,7 @@ class NetworkBatchSource:
   # -- network phase
 
   def _net_phase(self, epoch, state, claimer):
+    ledger = get_ledger()
     while True:
       gi = self._next_target(epoch, state, claimer)
       if gi is None:
@@ -731,6 +752,13 @@ class NetworkBatchSource:
             'again)')
         return 'lost'
       if op == 'batch':
+        if ledger.enabled:
+          # serve.rx: the same frame the server hashed pre-send, hashed
+          # again post-receive on the client — a tx/rx digest mismatch
+          # at the same (epoch, gi) is wire-or-server corruption, not a
+          # pipeline divergence.
+          ledger.record('serve.rx', fingerprint_packed(header['spec'], body),
+                        epoch=epoch, gi=gi)
         batch = unpack_batch(header['spec'], body)
         yield gi, batch
         self._mark_delivered(epoch, gi, state, claimer, ack=True)

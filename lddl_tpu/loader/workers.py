@@ -51,7 +51,11 @@ import sys
 import time
 import traceback
 
+from ..core import faults
 from ..telemetry import get_telemetry
+from ..telemetry.ledger import (first_array_span, first_ndarray,
+                                fingerprint_batch, fingerprint_packed,
+                                get_ledger)
 from ..telemetry.trace import get_tracer
 from .shm import BatchRing, SlotOverflow, default_slot_bytes
 
@@ -298,7 +302,15 @@ class MultiprocessLoader:
       self._net_source = NetworkBatchSource(
           build_kwargs=self._kwargs, factory=self._factory,
           comm=self._client_comm)
-    for _, batch in self._net_source.iter_steps(epoch, first_step):
+    ledger = get_ledger()
+    for gi, batch in self._net_source.iter_steps(epoch, first_step):
+      if ledger.enabled:
+        # Same collate boundary as the process transports, recorded at
+        # the same point (delivery to the consumer), keyed by the
+        # served global index — for a single client gi IS the serial
+        # step, so the stream audits against a local run's ledger.
+        ledger.record('collate', fingerprint_batch(batch), epoch=epoch,
+                      index=gi)
       yield batch
     self._serial.epoch = epoch + 1
 
@@ -315,6 +327,7 @@ class MultiprocessLoader:
     self._serial._batches_consumed = 0
     tele = get_telemetry()
     tracer = get_tracer()
+    ledger = get_ledger()
     stall_h = tele.histogram('loader.pull_stall_seconds')
     depth_g = tele.gauge('loader.queue_depth')
     W = self._num_workers
@@ -362,6 +375,23 @@ class MultiprocessLoader:
         if kind == 'slot':
           assert a == step, f'worker {w} sent step {a}, expected {step}'
           slot, spec = b
+          if ledger.enabled:
+            # The collate boundary, parent side: hash the packed slot
+            # bytes directly (no unpack, no copy — the spec walk feeds
+            # the hasher the same canonical stream a live batch would).
+            # The corrupt drill fires first, into the slot's first
+            # array, so a damaged batch is damaged for real — the
+            # digest, the delivered arrays, and downstream boundaries
+            # all see the corruption, exactly like bad hardware would.
+            span = first_array_span(spec)
+            if span is not None:
+              faults.corrupt_bytes(
+                  'ledger.corrupt',
+                  memoryview(rings[w]._seg.buf)[span[0]:span[0] + span[1]],
+                  rank=ledger.rank, epoch=epoch, index=step)
+            ledger.record('collate',
+                          fingerprint_packed(spec, rings[w]._seg.buf),
+                          epoch=epoch, index=step)
           if self._zero_copy:
             # Views stay valid until this worker's slot supply recycles;
             # release the previous one only now that the consumer asked
@@ -377,6 +407,14 @@ class MultiprocessLoader:
           step += 1
         elif kind == 'batch':
           assert a == step, f'worker {w} sent step {a}, expected {step}'
+          if ledger.enabled:
+            arr = first_ndarray(b)
+            if arr is not None:
+              faults.corrupt_bytes('ledger.corrupt', arr.data,
+                                   rank=ledger.rank, epoch=epoch,
+                                   index=step)
+            ledger.record('collate', fingerprint_batch(b), epoch=epoch,
+                          index=step)
           yield b
           step += 1
         elif kind == 'done':

@@ -22,6 +22,8 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from ..telemetry.ledger import fingerprint_file, get_ledger
+
 
 def compute_bin_ids(num_tokens, bin_size, nbins):
   """Vectorized bin assignment; ``num_tokens`` is array-like of ints."""
@@ -85,6 +87,16 @@ def write_shard_file(table, path, output_format='parquet',
           f.write(repr(row) + '\n')
     else:
       raise ValueError(f'unknown output_format {output_format!r}')
+    ledger = get_ledger()
+    if ledger.enabled:
+      # The shard boundary: fingerprint the exact bytes about to be
+      # renamed into place. File bytes, not table content — a
+      # writer-version or codec change that alters the file is a real
+      # difference a resumed run would re-read. Keyed by basename (the
+      # name is deterministic); multi-process writers append to the
+      # same rank ledger, so the auditor aligns this boundary by key.
+      ledger.record('shard', fingerprint_file(tmp),
+                    path=os.path.basename(path))
     os.rename(tmp, path)
   finally:
     if os.path.exists(tmp):

@@ -37,6 +37,15 @@ MFU numerator, ``device.memory_stats()`` HBM gauges at the scrape
 cadence, on-demand ``jax.profiler`` capture armed over the monitor's
 ``/profile`` endpoint, and the ``lddl-perf`` regression gate over bench
 history.
+
+The determinism plane (:mod:`.ledger` + :mod:`.audit`, env
+``LDDL_LEDGER``) turns the stack's byte-identity contracts into
+runtime-verified facts: streaming content fingerprints at every
+pipeline boundary appended to crash-durable ``ledger.rank<R>.jsonl``
+files, cross-run/cross-rank diffing with first-divergence bisection
+(``lddl-audit``), and live divergence verdicts over the comm backend
+feeding ``verdict.determinism`` and the monitor's DIVERGED panel. Same
+no-op discipline: unset means zero files, zero hashing.
 """
 
 from .metrics import (
@@ -95,4 +104,20 @@ from .trace import (
     load_trace_files,
     merge_trace_files,
     trace_file_name,
+)
+from .ledger import (
+    NOOP_LEDGER,
+    Ledger,
+    NoopLedger,
+    compare_signals,
+    determinism_verdict,
+    disable_ledger,
+    divergence_over_comm,
+    enable_ledger,
+    fingerprint_batch,
+    fingerprint_bytes,
+    fingerprint_file,
+    fingerprint_packed,
+    get_ledger,
+    ledger_file_name,
 )

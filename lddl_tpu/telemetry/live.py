@@ -126,16 +126,20 @@ def live_verdict(window):
   ``{'bottleneck': 'unknown (window warming up)'}`` until the window
   holds two samples.
   """
+  from .ledger import determinism_verdict
   merged, sec = _merged_delta(window)
   if merged is None:
     return {'stages': {}, 'bottleneck': 'unknown (window warming up)',
             'detail': '', 'window_sec': 0.0, 'roofline': None,
-            'serve': None}
+            'serve': None, 'determinism': determinism_verdict()}
   verdict = summarize_stages(merged)
   verdict['window_sec'] = sec
   from .roofline import roofline_verdict
   verdict['roofline'] = roofline_verdict(merged, sec)
   verdict['serve'] = serve_verdict(merged, sec)
+  # None whenever LDDL_LEDGER is off: determinism checking is opt-in
+  # and a quiet dashboard must stay quiet.
+  verdict['determinism'] = determinism_verdict()
   return verdict
 
 
@@ -473,6 +477,13 @@ def live_status(window, rank=0, telemetry=None, include_metrics=True):
   status['hbm'] = hbm
   merged_cum = merge_metric_lines([lines]) if lines else {'metrics': {}}
   status['goodput'] = goodput_meters(merged_cum)
+  from .ledger import get_ledger
+  ledger = get_ledger()
+  if ledger.enabled:
+    # Raw per-boundary stream heads for the monitor's client-side
+    # cross-rank comparison (compare_signals over every polled rank) —
+    # the same payload divergence_over_comm allgathers in-run.
+    status['ledger'] = ledger.signals()
   if include_metrics:
     status['metrics'] = lines
   return status

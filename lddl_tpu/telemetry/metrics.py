@@ -279,6 +279,11 @@ class Telemetry:
     fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + '.tmp.')
     with os.fdopen(fd, 'w') as f:
       f.write(payload)
+      # fsync before the rename: os.replace is atomic for the *name*,
+      # but a machine crash between rename and writeback can land the
+      # new name on truncated content. Durable-then-visible instead.
+      f.flush()
+      os.fsync(f.fileno())
     os.replace(tmp, path)
     return path
 
@@ -304,9 +309,17 @@ def diff_snapshot_lines(old, new):
       a conservative envelope;
     - gauges pass through the new capture (last-value semantics).
 
-  Metrics that first appear in ``new`` diff against zero. Negative
-  deltas (a registry recreated mid-window) clamp to zero rather than
-  reporting time running backwards.
+  Metrics that first appear in ``new`` diff against zero. A negative
+  ``window_sec`` (monotonic clocks from different boots) clamps to
+  zero. A cumulative metric that went *backwards* means the process
+  restarted and its registry reset — the old anchor belongs to a dead
+  incarnation, so the window re-anchors at the reset: the new
+  cumulative value passes through as the window's delta (every event
+  it counts happened since the restart, which is inside this window)
+  and the line is marked ``reset: true``. The old clamp-to-zero
+  behavior made a restarted rank read as 0 events/sec for a full
+  window, which ``straggler_scores`` maps to ``inf`` — a false
+  straggler verdict against the one rank that just recovered.
   """
   old_by_name, old_meta = {}, None
   for line in old:
@@ -332,19 +345,27 @@ def diff_snapshot_lines(old, new):
       continue
     d = dict(line)
     if kind == 'counter':
-      d['total'] = max(line.get('total', 0) - prev.get('total', 0), 0)
+      if line.get('total', 0) < prev.get('total', 0):
+        d['total'] = line.get('total', 0)  # re-anchor at the restart
+        d['reset'] = True
+      else:
+        d['total'] = line.get('total', 0) - prev.get('total', 0)
     elif kind == 'histogram':
-      d['count'] = max(line.get('count', 0) - prev.get('count', 0), 0)
-      d['sum'] = max(line.get('sum', 0.0) - prev.get('sum', 0.0), 0.0)
-      old_b = prev.get('buckets') or {}
-      d['buckets'] = {
-          k: v - old_b.get(k, 0)
-          for k, v in (line.get('buckets') or {}).items()
-          if v - old_b.get(k, 0) > 0
-      }
-      if d['count'] == 0:
-        d.pop('min', None)
-        d.pop('max', None)
+      if line.get('count', 0) < prev.get('count', 0):
+        # Re-anchor: the new capture IS the since-restart window.
+        d['reset'] = True
+      else:
+        d['count'] = line.get('count', 0) - prev.get('count', 0)
+        d['sum'] = max(line.get('sum', 0.0) - prev.get('sum', 0.0), 0.0)
+        old_b = prev.get('buckets') or {}
+        d['buckets'] = {
+            k: v - old_b.get(k, 0)
+            for k, v in (line.get('buckets') or {}).items()
+            if v - old_b.get(k, 0) > 0
+        }
+        if d['count'] == 0:
+          d.pop('min', None)
+          d.pop('max', None)
     out.append(d)
   return out
 

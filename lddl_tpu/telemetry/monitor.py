@@ -25,6 +25,7 @@ import sys
 import time
 import urllib.request
 
+from .ledger import compare_signals
 from .live import straggler_scores
 
 
@@ -149,6 +150,13 @@ def poll_fleet(urls, timeout=5.0):
       'verdicts': {r: s.get('verdict', {}).get('bottleneck', 'unknown')
                    for r, s in ranks.items()},
   }
+  # Cross-rank determinism: compare the ledger stream heads every rank
+  # exports in its snapshot — identical arithmetic to the in-run
+  # divergence_over_comm path, so dashboard and run agree. None when no
+  # rank runs with LDDL_LEDGER (the ledger key is absent).
+  ledgers = {r: s.get('ledger') for r, s in ranks.items() if s.get('ledger')}
+  fleet['determinism'] = (compare_signals(ledgers)
+                          if len(ledgers) > 1 else None)
   return fleet
 
 
@@ -264,6 +272,28 @@ def render_frame(fleet, clear=True):
     for rank in sorted(strag['scores']):
       mark = '  <-- slowest' if rank == strag['slowest'] else ''
       out.append(f'  rank {rank}: {strag["scores"][rank]:.3f}{mark}')
+  det = fleet.get('determinism')
+  if det and det.get('status') == 'diverged':
+    out.append('')
+    out.append('!! DIVERGED — ranks no longer byte-identical:')
+    first = det.get('first') or {}
+    line = f'  first divergence: boundary {first.get("boundary", "?")}'
+    if first.get('key'):
+      line += ' at ' + ', '.join(str(k) for k in first['key'])
+    digests = first.get('digests') or {}
+    if digests:
+      line += ' — rank ' + ' vs rank '.join(
+          f'{r} {d}' for r, d in sorted(digests.items()))
+    if not first.get('key'):
+      line += ' (first differing batch predates the retained window; ' \
+              'run lddl-audit on the ledgers for the exact coordinate)'
+    out.append(line)
+    for b, entry in sorted((det.get('boundaries') or {}).items()):
+      out.append(f'  {b}: {entry.get("status")} · counts '
+                 f'{entry.get("counts")}')
+  elif det and det.get('status') == 'ok':
+    out.append('')
+    out.append('determinism: ok (replicated ledger streams agree)')
   return '\n'.join(out)
 
 

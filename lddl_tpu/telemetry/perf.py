@@ -224,9 +224,35 @@ def attach_args(parser):
                            '(default %(default)s)')
   parser.add_argument('--gate', action='store_true',
                       help='exit 1 when any series regressed (CI mode)')
+  parser.add_argument('--audit', nargs='+', metavar='LEDGER',
+                      help='also run the determinism auditor over these '
+                           'ledger paths: one path self-checks the run '
+                           '(replay conflicts, wire damage), two paths '
+                           'verify the first against the second '
+                           '(lddl-audit verify). Under --gate the audit '
+                           'exit code folds into the return code, so one '
+                           'command gates perf and determinism.')
   parser.add_argument('--json', action='store_true', dest='as_json',
                       help='emit the full verdict list as JSON')
   return parser
+
+
+def run_audit(paths):
+  """Run the determinism auditor for ``--audit`` and return its exit code.
+
+  One path self-diffs the run (catches intra-run replay conflicts and
+  serve.tx/serve.rx wire damage with no reference needed); two paths
+  verify the first against the second. More than two is a usage error
+  (exit 2) — verify compares exactly one run against one reference.
+  """
+  from lddl_tpu.telemetry.audit import main as audit_main
+  if len(paths) == 1:
+    return audit_main(['diff', paths[0], paths[0]])
+  if len(paths) == 2:
+    return audit_main(['verify', paths[0], paths[1]])
+  print('lddl-perf: --audit takes one ledger path (self-check) or two '
+        '(run, reference)', file=sys.stderr)
+  return 2
 
 
 def main(argv=None):
@@ -234,6 +260,9 @@ def main(argv=None):
       prog='lddl-perf',
       description='robust perf-regression check over bench history')) \
       .parse_args(argv)
+  # Determinism leg first: its findings print even when the perf leg
+  # later bails on missing history, so CI logs always show both verdicts.
+  audit_rc = run_audit(args.audit) if args.audit else 0
   series = gather_series(args.root, args.history)
   if not series:
     print(f'lddl-perf: no bench history under {args.root!r} '
@@ -246,8 +275,10 @@ def main(argv=None):
               for name, values in sorted(series.items())]
   regressions = [v for v in verdicts if v['status'] == 'regression']
   if args.as_json:
-    print(json.dumps({'verdicts': verdicts,
-                      'regressions': len(regressions)}, indent=2))
+    out = {'verdicts': verdicts, 'regressions': len(regressions)}
+    if args.audit:
+      out['audit_exit'] = audit_rc
+    print(json.dumps(out, indent=2))
   else:
     for v in verdicts:
       line = f'{v["status"]:>18}  {v["metric"]}  n={v["points"]}'
@@ -260,7 +291,15 @@ def main(argv=None):
       names = ', '.join(v['metric'] for v in regressions)
       print(f'lddl-perf: {len(regressions)} regression(s): {names}',
             file=sys.stderr)
-  return 1 if (args.gate and regressions) else 0
+    if args.audit and audit_rc == 0:
+      print('lddl-perf: determinism audit ok')
+  # One command, one verdict: under --gate a determinism failure is a
+  # gate failure exactly like a perf regression (perf's code wins when
+  # both fired, so CI triage starts from the regression list).
+  rc = 1 if (args.gate and regressions) else 0
+  if args.gate and audit_rc and not rc:
+    rc = audit_rc
+  return rc
 
 
 if __name__ == '__main__':

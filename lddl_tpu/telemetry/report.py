@@ -37,8 +37,19 @@ def load_rank_files(directory):
         '(run with LDDL_TELEMETRY=1 and LDDL_TELEMETRY_DIR set)')
   out = []
   for p in paths:
+    lines = []
     with open(p) as f:
-      out.append([json.loads(line) for line in f if line.strip()])
+      for ln, line in enumerate(f, start=1):
+        if not line.strip():
+          continue
+        try:
+          lines.append(json.loads(line))
+        except ValueError:
+          # A SIGKILLed exporter can leave a torn trailing line; keep
+          # the readable prefix instead of failing the whole report.
+          print(f'telemetry-report: skipping unparseable line {ln} of '
+                f'{p} (truncated write?)', file=sys.stderr)
+    out.append(lines)
   return out
 
 

@@ -255,6 +255,10 @@ class Tracer:
     fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + '.tmp.')
     with os.fdopen(fd, 'w') as f:
       f.write(payload)
+      # Durable-then-visible (same as MetricsRegistry.write_jsonl): the
+      # rename must never make a name point at unwritten-back content.
+      f.flush()
+      os.fsync(f.fileno())
     os.replace(tmp, path)
     self._last_flush = time.monotonic()
     return path
@@ -318,10 +322,17 @@ def load_trace_files(directory):
   for p in paths:
     meta, events = None, []
     with open(p) as f:
-      for line in f:
+      for ln, line in enumerate(f, start=1):
         if not line.strip():
           continue
-        d = json.loads(line)
+        try:
+          d = json.loads(line)
+        except ValueError:
+          # A SIGKILLed writer can leave a torn trailing line; the rest
+          # of the file is intact and far more useful than an abort.
+          print(f'telemetry-trace: skipping unparseable line {ln} of '
+                f'{p} (truncated write?)', file=sys.stderr)
+          continue
         if d.get('kind') == 'meta':
           meta = d
         else:

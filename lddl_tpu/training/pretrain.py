@@ -171,6 +171,9 @@ class TrainLoop:
   # None when it ran to max_steps. The supervisor's relaunch signal.
   stop_reason: object = None
   _last_saved: int = dataclasses.field(default=-1, repr=False)
+  # Most recent step loss, carried onto the ledger's checkpoint-boundary
+  # fingerprint as context (never part of the alignment key).
+  _last_loss: object = dataclasses.field(default=None, repr=False)
 
   @classmethod
   def build(cls, path, tokenizer, *, model_cfg, mesh, learning_rate=1e-4,
@@ -277,16 +280,50 @@ class TrainLoop:
     state = {'params': self.params, 'opt_state': self.opt_state,
              'rng': jax.random.key_data(self.rng)}
     meta = {'samples_seen': self.samples_seen, 'step': self.step}
+    from ..telemetry.ledger import get_ledger
+    ledger = get_ledger()
     if writer is not None:
       from ..parallel.train import snapshot_for_checkpoint
       from ..telemetry import get_telemetry
       snap = snapshot_for_checkpoint(state)
+      if ledger.enabled:
+        self._record_step_fingerprint(ledger, snap)
       writer.submit(self._write_ckpt, ckpt_dir, keep, self.step, snap, meta)
       get_telemetry().gauge('train.ckpt_backlog').set(writer.backlog)
     else:
+      if ledger.enabled:
+        from ..parallel.train import snapshot_for_checkpoint
+        self._record_step_fingerprint(ledger,
+                                      snapshot_for_checkpoint(state))
       self._write_ckpt(ckpt_dir, keep, self.step, state, meta)
     self._last_saved = self.step
     return self.step
+
+  def _record_step_fingerprint(self, ledger, snap):
+    """The ``step`` ledger boundary: a content fingerprint of the full
+    train state (params + opt_state + rng, the donation-safe host
+    snapshot the checkpoint writer serializes) at every checkpoint
+    boundary, keyed by global step. Train state is rank-identical after
+    the gradient all-reduce, so this is the boundary the cross-rank
+    divergence verdict compares by default — and the one that catches a
+    resumed/resharded run whose arithmetic drifted from the parent.
+    Multi-host sharded leaves stay on device in the snapshot; they are
+    reduced to their local addressable bytes here (identical across
+    runs of the same topology)."""
+    import jax
+    import numpy as np
+
+    from ..telemetry.ledger import fingerprint_batch
+
+    def _host(x):
+      if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return np.asarray(x.addressable_data(0))
+      return x
+    digest = fingerprint_batch(jax.tree_util.tree_map(_host, snap))
+    coords = {'step': self.step, 'samples': self.samples_seen}
+    if self._last_loss is not None:
+      coords['loss'] = self._last_loss
+    ledger.record('step', digest, **coords)
 
   def _write_ckpt(self, ckpt_dir, keep, step, state, meta):
     """The actual orbax write — runs inline (sync save) or on the
@@ -486,6 +523,7 @@ class TrainLoop:
           # compute span covers real execution, not just dispatch.
           loss = float(metrics['loss'])
           losses.append(loss)
+          self._last_loss = loss
           self.step += 1
           self.samples_seen += global_batch
           finished_trace = profiler.on_step()

@@ -43,6 +43,7 @@ setup(
             'lddl-analyze=lddl_tpu.analysis.cli:main',
             'lddl-monitor=lddl_tpu.telemetry.monitor:main',
             'lddl-perf=lddl_tpu.telemetry.perf:main',
+            'lddl-audit=lddl_tpu.telemetry.audit:main',
             'lddl-data-server=lddl_tpu.loader.service:main',
         ],
     },

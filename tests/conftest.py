@@ -41,14 +41,20 @@ def _reset_telemetry_registries():
   every test: a test calling ``telemetry.enable()`` (or flipping
   ``LDDL_TELEMETRY``/``LDDL_TRACE`` and re-resolving) without disabling
   must not leak an enabled registry into later tests."""
+  import lddl_tpu.telemetry.ledger as _tl
   import lddl_tpu.telemetry.metrics as _tm
   import lddl_tpu.telemetry.profiling as _tp
   import lddl_tpu.telemetry.roofline as _tr
   import lddl_tpu.telemetry.server as _ts
   import lddl_tpu.telemetry.trace as _tt
-  old = (_tm._active, _tt._active)
+  old = (_tm._active, _tt._active, _tl._active)
   yield
-  _tm._active, _tt._active = old
+  # A test that enabled the determinism ledger must not leak its open
+  # append fd (or its cached resolution) into later tests.
+  if _tl._active is not None and _tl._active.enabled and \
+      _tl._active is not old[2]:
+    _tl._active.close()
+  _tm._active, _tt._active, _tl._active = old
   # A test that started an LDDL_MONITOR server must not leak its thread
   # (or its cached resolution) into later tests.
   if _ts._active is not None and _ts._active.enabled:

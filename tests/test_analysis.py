@@ -512,6 +512,74 @@ def test_lda012_exempts_tests_and_testing():
 
 
 # ---------------------------------------------------------------------------
+# LDA013: salted builtin hash() escaping the process
+
+
+def test_lda013_flags_persisted_and_placed_hash():
+  assert run("""
+      def shard_of(key, n):
+        return hash(key) % n
+      def export(f, text):
+        f.write(hash(text))
+      """) == ['LDA013', 'LDA013']
+
+
+def test_lda013_flags_tainted_name_reaching_sink():
+  assert run("""
+      def export(sock, text):
+        h = hash(text)
+        sock.sendall(h)
+      """) == ['LDA013']
+
+
+def test_lda013_clean_for_process_local_use():
+  # Same-interpreter comparisons and the __hash__ protocol never leave
+  # the process; hashlib is the sanctioned stable alternative.
+  assert run("""
+      import hashlib
+      class Key:
+        def __hash__(self):
+          return hash(self.name)
+      def same(a, b):
+        return hash(a) == hash(b)
+      def fingerprint(f, text):
+        f.write(hashlib.blake2b(text.encode()).hexdigest())
+      """) == []
+
+
+def test_lda013_sink_receiver_named_hash_is_not_a_sink():
+  # Only the payload position counts: writing *to* something hash-named
+  # is fine, and an aliased local `hash` is not the builtin.
+  assert run("""
+      def store(hash_index, value):
+        hash_index.write(value)
+      def local(xs):
+        from mymod import hash
+        return hash(xs)
+      """) == []
+
+
+def test_lda013_pragma_suppresses():
+  findings = run_findings("""
+      def bucket(key, n):
+        # lddl: noqa[LDA013] in-memory routing only, never persisted
+        return hash(key) % n
+      """)
+  assert [f.rule_id for f in findings] == ['LDA013']
+  assert findings[0].suppressed
+
+
+def test_lda013_exempts_tests_and_testing():
+  src = """
+      def export(f, text):
+        f.write(hash(text))
+      """
+  assert run(src, path='tests/test_something.py') == []
+  assert run(src, path='lddl_tpu/testing.py') == []
+  assert run(src) == ['LDA013']
+
+
+# ---------------------------------------------------------------------------
 # Engine / pragmas / CLI
 
 

@@ -1,0 +1,621 @@
+"""Determinism ledger (telemetry/ledger.py) + audit CLI (telemetry/
+audit.py): streaming content fingerprints at every pipeline boundary,
+cross-run/cross-rank bisection, and live divergence detection.
+
+The contract under test, end to end:
+
+  - ``LDDL_LEDGER`` unset: the no-op singleton — zero files, zero
+    threads, ``record()`` never hashes a byte (the metrics.py/trace.py
+    gate discipline);
+  - live and packed representations of one batch fingerprint
+    identically, so shm slots, wire frames, and in-process batches all
+    audit against each other;
+  - records survive SIGKILL torn-line style damage, intra-run replays
+    that come back different are conflicts, mixed-hash ledgers refuse
+    to compare;
+  - an injected ``ledger.corrupt`` byte flip in a 2-rank loader run is
+    bisected by ``lddl-audit diff`` to the exact (epoch, batch);
+  - a serve.tx/serve.rx digest split inside ONE run (wire damage) fails
+    the audit with the damaged frame's coordinate;
+  - ``divergence_over_comm`` over a real FileBackend yields the same
+    verdict on every rank, feeds ``verdict.determinism``, and renders
+    as the lddl-monitor DIVERGED panel.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lddl_tpu.core import faults
+from lddl_tpu.telemetry import audit
+from lddl_tpu.telemetry import ledger as ledger_mod
+from lddl_tpu.telemetry.ledger import (ALGO, NOOP_LEDGER, Ledger,
+                                       compare_signals, determinism_verdict,
+                                       disable_ledger, divergence_over_comm,
+                                       enable_ledger, fingerprint_batch,
+                                       fingerprint_bytes, fingerprint_file,
+                                       fingerprint_packed, first_array_span,
+                                       first_ndarray, get_ledger,
+                                       ledger_file_name, record_key)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_gate(monkeypatch):
+  """Each test resolves the ledger gate from a clean environment; the
+  conftest fixture restores the module global afterwards."""
+  for var in ('LDDL_LEDGER', 'LDDL_LEDGER_WINDOW', 'LDDL_LEDGER_FSYNC',
+              'LDDL_LEDGER_REPLICATED', 'LDDL_TELEMETRY_DIR',
+              'LDDL_FAULTS'):
+    monkeypatch.delenv(var, raising=False)
+  ledger_mod._active = None
+  faults.reset()
+  yield
+  faults.reset()
+
+
+def _sample_batch():
+  return {
+      'input_ids': np.arange(64, dtype=np.int32).reshape(4, 16),
+      'attention_mask': np.ones((4, 16), np.int8),
+      'next_sentence_labels': np.zeros(4, np.int32),
+      'meta': (np.float32([1.5, -2.0]), 'tag'),
+      'count': 7,
+  }
+
+
+# ---------------------------------------------------------------------------
+# gate discipline: disabled must cost nothing
+
+
+class TestGate:
+
+  def test_unset_is_noop_singleton_no_files_no_threads(self, monkeypatch,
+                                                       tmp_path):
+    monkeypatch.setenv('LDDL_TELEMETRY_DIR', str(tmp_path))
+    threads_before = set(threading.enumerate())
+    led = get_ledger()
+    assert led is NOOP_LEDGER and not led.enabled
+    assert led.record('collate', 'deadbeef', epoch=0, index=0) is None
+    assert led.signals() == {}
+    assert led.fleet_verdict() is None
+    led.flush()
+    led.close()
+    assert get_ledger() is led  # shared singleton, resolved once
+    assert os.listdir(tmp_path) == []  # never even creates the dir entry
+    assert set(threading.enumerate()) == threads_before
+
+  def test_env_enables_and_writes_meta(self, monkeypatch, tmp_path):
+    monkeypatch.setenv('LDDL_LEDGER', '1')
+    monkeypatch.setenv('LDDL_TELEMETRY_DIR', str(tmp_path))
+    led = get_ledger()
+    assert led.enabled
+    path = ledger_file_name(str(tmp_path), 0)
+    assert os.path.exists(path)
+    parsed = audit.load_ledger_file(path)
+    assert parsed['meta'][0]['algo'] == ALGO
+    assert parsed['meta'][0]['rank'] == 0
+    disable_ledger()
+    assert not get_ledger().enabled
+
+  def test_disable_is_idempotent_and_closes(self, tmp_path):
+    led = enable_ledger(directory=str(tmp_path), rank=2)
+    led.record('step', 'aa', step=1)
+    disable_ledger()
+    disable_ledger()
+    assert get_ledger() is NOOP_LEDGER
+
+
+# ---------------------------------------------------------------------------
+# fingerprints: representation independence
+
+
+class TestFingerprints:
+
+  def test_live_and_packed_representations_agree(self):
+    from lddl_tpu.loader.service import pack_batch
+    batch = _sample_batch()
+    spec, payload = pack_batch(batch)
+    assert fingerprint_packed(spec, payload) == fingerprint_batch(batch)
+
+  def test_digest_independent_of_slot_offset(self):
+    from lddl_tpu.loader.shm import _pack_into
+    batch = _sample_batch()
+    buf = bytearray(1 << 16)
+    spec, _ = _pack_into(batch, buf, 512, len(buf))
+    assert fingerprint_packed(spec, buf) == fingerprint_batch(batch)
+
+  def test_content_sensitivity_single_element(self):
+    a = _sample_batch()
+    b = _sample_batch()
+    b['input_ids'] = b['input_ids'].copy()
+    b['input_ids'][2, 3] += 1
+    assert fingerprint_batch(a) != fingerprint_batch(b)
+
+  def test_first_array_span_targets_real_content(self):
+    from lddl_tpu.loader.service import pack_batch
+    batch = _sample_batch()
+    spec, payload = pack_batch(batch)
+    span = first_array_span(spec)
+    assert span is not None and span[1] == batch['input_ids'].nbytes
+    damaged = bytearray(payload)
+    damaged[span[0]] ^= 0xFF
+    assert (fingerprint_packed(spec, damaged) !=
+            fingerprint_packed(spec, payload))
+    assert first_ndarray(batch) is batch['input_ids']
+    assert first_ndarray('scalar-only') is None
+
+  def test_fingerprint_file_hashes_exact_bytes(self, tmp_path):
+    p = tmp_path / 'shard.bin'
+    p.write_bytes(b'exact shard bytes' * 100)
+    assert fingerprint_file(str(p)) == fingerprint_bytes(p.read_bytes())
+
+  def test_corrupt_bytes_fault_flips_one_byte(self, monkeypatch):
+    monkeypatch.setenv('LDDL_FAULTS', 'corrupt:ledger.corrupt:nth=2,at=5')
+    faults.reset()
+    buf = bytearray(b'\x00' * 16)
+    assert not faults.corrupt_bytes('ledger.corrupt', buf)  # 1st: nth=2
+    assert faults.corrupt_bytes('ledger.corrupt', buf)
+    assert buf[5] == 0xFF and sum(buf) == 0xFF
+
+
+# ---------------------------------------------------------------------------
+# the ledger file: durable append, rolling chain, keys
+
+
+class TestLedgerRecords:
+
+  def test_rolling_chain_and_line_shape(self, tmp_path):
+    led = Ledger(directory=str(tmp_path), rank=3)
+    digests = [fingerprint_bytes(b'batch%d' % i) for i in range(3)]
+    rolling = ''
+    for i, d in enumerate(digests):
+      rolling = fingerprint_bytes(rolling.encode(), d.encode())
+      assert led.record('collate', d, epoch=0, index=i) == rolling
+    led.close()
+    parsed = audit.load_ledger_file(ledger_file_name(str(tmp_path), 3))
+    assert [r['n'] for r in parsed['records']] == [1, 2, 3]
+    assert [r['digest'] for r in parsed['records']] == digests
+    assert parsed['records'][-1]['rolling'] == rolling
+    assert record_key(parsed['records'][1]) == (('epoch', 0), ('index', 1))
+
+  def test_context_coords_ride_along_without_keying(self, tmp_path):
+    led = Ledger(directory=str(tmp_path), rank=0)
+    led.record('step', 'ab12', step=7, samples=56, loss=2.25, final=True)
+    led.close()
+    rec = audit.load_ledger_file(led.path)['records'][0]
+    assert record_key(rec) == (('step', 7),)
+    assert rec['loss'] == 2.25 and rec['final'] is True
+
+  def test_torn_tail_line_tolerated(self, tmp_path):
+    led = Ledger(directory=str(tmp_path), rank=0)
+    for i in range(3):
+      led.record('collate', f'{i:08x}', epoch=0, index=i)
+    led.close()
+    with open(led.path, 'a') as f:
+      f.write('{"boundary":"collate","dige')  # SIGKILL mid-append
+    parsed = audit.load_ledger_file(led.path)
+    assert parsed['bad_lines'] == 1
+    assert len(parsed['records']) == 3
+
+  def test_signals_window_bounds_recent(self, tmp_path):
+    led = Ledger(directory=str(tmp_path), rank=0, window=4)
+    for i in range(10):
+      led.record('step', f'{i:04x}', step=i)
+    led.close()
+    sig = led.signals()['step']
+    assert sig['count'] == 10
+    assert [k for k, _ in sig['recent']] == [[6], [7], [8], [9]]
+
+
+# ---------------------------------------------------------------------------
+# audit: diff / verify / bisect
+
+
+def _write_run(directory, rank, records):
+  """records: [(boundary, digest, coords-dict)]"""
+  led = Ledger(directory=str(directory), rank=rank)
+  for boundary, digest, coords in records:
+    led.record(boundary, digest, **coords)
+  led.close()
+  return led.path
+
+
+def _stream(boundary, n, salt='', keyf=None):
+  keyf = keyf or (lambda i: {'epoch': 0, 'index': i})
+  return [(boundary, fingerprint_bytes(f'{boundary}{salt}{i}'.encode()),
+           keyf(i)) for i in range(n)]
+
+
+class TestAudit:
+
+  def test_identical_runs_are_consistent_exit_zero(self, tmp_path, capsys):
+    recs = (_stream('collate', 4) +
+            _stream('step', 2, keyf=lambda i: {'step': i}))
+    a, b = tmp_path / 'a', tmp_path / 'b'
+    _write_run(a, 0, recs)
+    _write_run(b, 0, recs)
+    result = audit.audit_diff(audit.load_run(str(a)),
+                              audit.load_run(str(b)))
+    assert not result['divergent'] and result['first'] is None
+    assert audit.main(['diff', str(a), str(b)]) == 0
+    assert 'consistent' in capsys.readouterr().out
+
+  def test_bisects_first_divergence_in_lineage_order(self, tmp_path,
+                                                     capsys):
+    base = (_stream('shard', 2, keyf=lambda i: {'path': f'p.{i}.parquet'}) +
+            _stream('collate', 5) +
+            _stream('device', 5, keyf=lambda i: {'index': i}) +
+            _stream('step', 3, keyf=lambda i: {'step': i}))
+    altered = []
+    for boundary, digest, coords in base:
+      # Damage collate batch 2 and everything downstream of it — the
+      # auditor must name collate (epoch 0, index 2), the lineage root.
+      if (boundary, coords.get('index')) in (('collate', 2), ('device', 2)) \
+          or (boundary, coords.get('step')) == ('step', 2):
+        digest = fingerprint_bytes(b'corrupted' + digest.encode())
+      altered.append((boundary, digest, coords))
+    a, b = tmp_path / 'a', tmp_path / 'b'
+    _write_run(a, 0, base)
+    _write_run(b, 0, altered)
+    result = audit.audit_diff(audit.load_run(str(a)),
+                              audit.load_run(str(b)))
+    assert result['divergent']
+    assert result['first']['boundary'] == 'collate'
+    assert result['first']['key'] == {'epoch': 0, 'index': 2}
+    assert audit.main(['diff', str(a), str(b)]) == 1
+    out = capsys.readouterr().out
+    assert 'first divergence' in out and 'collate' in out
+
+  def test_cross_rank_file_diff_aligns_single_rank_inputs(self, tmp_path,
+                                                          capsys):
+    d = tmp_path / 'run'
+    p0 = _write_run(d, 0, _stream('collate', 4))
+    p1 = _write_run(d, 1, _stream('collate', 4))
+    assert audit.main(['diff', p0, p1]) == 0
+    capsys.readouterr()
+    p2 = _write_run(tmp_path / 'other', 1, _stream('collate', 4, salt='x'))
+    assert audit.main(['diff', p0, p2]) == 1
+
+  def test_verify_subset_coverage_passes(self, tmp_path, capsys):
+    ref = _stream('collate', 6) + _stream('step', 3,
+                                          keyf=lambda i: {'step': i})
+    child = ref[3:]  # resumed mid-stream: strict subset, same digests
+    a, b = tmp_path / 'child', tmp_path / 'ref'
+    _write_run(a, 0, child)
+    _write_run(b, 0, ref)
+    result = audit.audit_verify(audit.load_run(str(a)),
+                                audit.load_run(str(b)))
+    assert not result['divergent']
+    cov = result['coverage'][0]['collate']
+    assert cov == {'common': 3, 'run_only': 0, 'reference_only': 3}
+    assert audit.main(['verify', str(a), str(b)]) == 0
+    assert 'coverage' in capsys.readouterr().out
+
+  def test_verify_fails_on_conflicting_digest(self, tmp_path):
+    ref = _stream('collate', 6)
+    child = list(ref[2:])
+    boundary, digest, coords = child[1]
+    child[1] = (boundary, fingerprint_bytes(b'drift'), coords)
+    a, b = tmp_path / 'child', tmp_path / 'ref'
+    _write_run(a, 0, child)
+    _write_run(b, 0, ref)
+    assert audit.main(['verify', str(a), str(b)]) == 1
+
+  def test_intra_run_replay_conflict_detected(self, tmp_path):
+    recs = _stream('collate', 3)
+    recs.append(('collate', fingerprint_bytes(b'replay-differs'),
+                 {'epoch': 0, 'index': 1}))
+    a, b = tmp_path / 'a', tmp_path / 'b'
+    _write_run(a, 0, recs)
+    _write_run(b, 0, _stream('collate', 3))
+    result = audit.audit_diff(audit.load_run(str(a)),
+                              audit.load_run(str(b)))
+    assert result['conflicts'] and result['divergent']
+    assert result['conflicts'][0]['key'] == {'epoch': 0, 'index': 1}
+
+  def test_mixed_algorithms_refuse_to_compare(self, tmp_path):
+    a, b = tmp_path / 'a', tmp_path / 'b'
+    _write_run(a, 0, _stream('collate', 2))
+    _write_run(b, 0, _stream('collate', 2))
+    path = ledger_file_name(str(b), 0)
+    other = 'xxh64' if ALGO != 'xxh64' else 'blake2b8'
+    lines = open(path).read().replace(f'"{ALGO}"', f'"{other}"')
+    with open(path, 'w') as f:
+      f.write(lines)
+    assert audit.main(['diff', str(a), str(b)]) == 2
+
+  def test_wire_mismatch_fails_within_single_run(self, tmp_path, capsys):
+    """A frame damaged between server hash (serve.tx) and client hash
+    (serve.rx) is caught with no reference run at all."""
+    good = fingerprint_bytes(b'frame-0')
+    sent = fingerprint_bytes(b'frame-1')
+    got = fingerprint_bytes(b'frame-1-damaged')
+    d = tmp_path / 'run'
+    _write_run(d, 0, [
+        ('serve.tx', good, {'epoch': 0, 'gi': 0}),
+        ('serve.rx', good, {'epoch': 0, 'gi': 0}),
+        ('serve.tx', sent, {'epoch': 0, 'gi': 1}),
+        ('serve.rx', got, {'epoch': 0, 'gi': 1}),
+    ])
+    run = audit.load_run(str(d))
+    mism = audit.wire_mismatches(run)
+    assert len(mism) == 1
+    assert mism[0]['key'] == {'epoch': 0, 'gi': 1}
+    result = audit.audit_diff(run, run)
+    assert result['divergent']
+    assert result['first']['boundary'] == 'serve.rx'
+    assert audit.main(['diff', str(d), str(d)]) == 1
+    assert 'wire' in capsys.readouterr().out
+    capsys.readouterr()
+    assert audit.main(['show', str(d)]) == 0
+    assert 'wire mismatch' in capsys.readouterr().out
+
+  def test_missing_input_exits_two(self, tmp_path, capsys):
+    assert audit.main(['diff', str(tmp_path / 'nope'),
+                       str(tmp_path / 'nope2')]) == 2
+    assert 'no ' in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# live divergence: compare_signals, comm exchange, monitor panel
+
+
+def _two_ledgers(tmp_path, diverge_at=None, extra_on_0=0, n=4):
+  leds = []
+  for r in (0, 1):
+    led = Ledger(directory=str(tmp_path / f'r{r}'), rank=r, window=8)
+    for i in range(n + (extra_on_0 if r == 0 else 0)):
+      payload = f'step{i}' + ('!' if r == 1 and i == diverge_at else '')
+      led.record('step', fingerprint_bytes(payload.encode()), step=i)
+    led.close()
+    leds.append(led)
+  return leds
+
+
+class TestLiveDivergence:
+
+  def test_compare_signals_ok(self, tmp_path):
+    l0, l1 = _two_ledgers(tmp_path)
+    v = compare_signals({0: l0.signals(), 1: l1.signals()})
+    assert v['status'] == 'ok' and v['first'] is None
+
+  def test_compare_signals_lagging_is_not_divergence(self, tmp_path):
+    l0, l1 = _two_ledgers(tmp_path, extra_on_0=2)
+    v = compare_signals({0: l0.signals(), 1: l1.signals()})
+    assert v['boundaries']['step']['status'] == 'lagging'
+    assert v['status'] != 'diverged'
+
+  def test_compare_signals_diverged_names_first_batch(self, tmp_path):
+    l0, l1 = _two_ledgers(tmp_path, diverge_at=2)
+    v = compare_signals({0: l0.signals(), 1: l1.signals()})
+    assert v['status'] == 'diverged'
+    assert v['first']['boundary'] == 'step'
+    assert v['first']['key'] == [2]
+    assert set(v['first']['digests']) == {0, 1}
+
+  def test_divergence_outside_window_reports_no_first(self, tmp_path):
+    # window=8, divergence at step 0 of a 16-record stream: the rolling
+    # digests disagree but the coordinate fell out of the window.
+    l0, l1 = _two_ledgers(tmp_path, diverge_at=0, n=16)
+    v = compare_signals({0: l0.signals(), 1: l1.signals()})
+    assert v['status'] == 'diverged' and v['first']['key'] is None
+
+  def test_non_replicated_boundaries_not_compared(self, tmp_path):
+    for r in (0, 1):
+      led = Ledger(directory=str(tmp_path / f'c{r}'), rank=r)
+      # data-parallel ranks legitimately consume different batches
+      led.record('collate', fingerprint_bytes(b'rank%d' % r),
+                 epoch=0, index=0)
+      led.close()
+      if r == 0:
+        s0 = led.signals()
+      else:
+        s1 = led.signals()
+    v = compare_signals({0: s0, 1: s1})
+    assert v['status'] is None and v['boundaries'] == {}
+    v = compare_signals({0: s0, 1: s1}, replicated=('collate',))
+    assert v['status'] == 'diverged'
+
+  def test_divergence_over_comm_all_ranks_agree(self, tmp_path):
+    from lddl_tpu.comm import FileBackend
+    rdv = str(tmp_path / 'rdv')
+    leds = _two_ledgers(tmp_path, diverge_at=2)
+    verdicts = [None, None]
+
+    def rank(r):
+      comm = FileBackend(rdv, r, 2, timeout=30.0, run_id='lv')
+      verdicts[r] = divergence_over_comm(comm, ledger=leds[r])
+
+    threads = [threading.Thread(target=rank, args=(r,)) for r in (0, 1)]
+    for t in threads:
+      t.start()
+    for t in threads:
+      t.join(timeout=60)
+    assert verdicts[0] == verdicts[1]
+    assert verdicts[0]['status'] == 'diverged'
+    assert verdicts[0]['first']['key'] == [2]
+    assert verdicts[0]['seq'] is not None
+    # the verdict is stashed for /snapshot consumers on every rank
+    for led in leds:
+      det = determinism_verdict(ledger=led)
+      assert det['status'] == 'diverged'
+      assert det['fleet'] == verdicts[0]
+
+  def test_divergence_over_comm_noop_when_disabled(self):
+    assert divergence_over_comm(object(), ledger=NOOP_LEDGER) is None
+
+  def test_determinism_verdict_states(self, tmp_path):
+    assert determinism_verdict(ledger=NOOP_LEDGER) is None
+    led = Ledger(directory=str(tmp_path), rank=0)
+    assert determinism_verdict(ledger=led)['status'] == 'idle'
+    led.record('step', 'ab', step=0)
+    det = determinism_verdict(ledger=led)
+    led.close()
+    assert det['status'] == 'ok'
+    assert det['streams']['step']['count'] == 1
+
+  def test_live_verdict_carries_determinism(self, tmp_path):
+    from lddl_tpu.telemetry.live import SnapshotWindow, live_verdict
+    ledger_mod._active = Ledger(directory=str(tmp_path), rank=0)
+    ledger_mod._active.record('step', 'cd', step=1)
+    verdict = live_verdict(SnapshotWindow())
+    assert verdict['determinism']['status'] == 'ok'
+    disable_ledger()
+    assert live_verdict(SnapshotWindow())['determinism'] is None
+
+
+class TestMonitorPanel:
+
+  def _fleet(self, det):
+    return {'ranks': {0: {}, 1: {}}, 'errors': {}, 'straggler': None,
+            'verdicts': {}, 'determinism': det}
+
+  def test_diverged_panel_names_rank_and_batch(self, tmp_path):
+    from lddl_tpu.telemetry.monitor import render_frame
+    l0, l1 = _two_ledgers(tmp_path, diverge_at=2)
+    det = compare_signals({0: l0.signals(), 1: l1.signals()})
+    frame = render_frame(self._fleet(det), clear=False)
+    assert '!! DIVERGED' in frame
+    assert 'boundary step at 2' in frame
+    assert 'rank 0' in frame and 'rank 1' in frame
+
+  def test_ok_and_absent_panels(self, tmp_path):
+    from lddl_tpu.telemetry.monitor import render_frame
+    l0, l1 = _two_ledgers(tmp_path)
+    det = compare_signals({0: l0.signals(), 1: l1.signals()})
+    assert 'determinism: ok' in render_frame(self._fleet(det), clear=False)
+    assert 'DIVERGED' not in render_frame(self._fleet(None), clear=False)
+
+  def test_poll_fleet_compares_snapshot_ledgers(self, tmp_path,
+                                                monkeypatch):
+    from lddl_tpu.telemetry import monitor as monitor_mod
+    l0, l1 = _two_ledgers(tmp_path, diverge_at=1)
+    snaps = {0: {'rank': 0, 'ledger': l0.signals()},
+             1: {'rank': 1, 'ledger': l1.signals()}}
+    monkeypatch.setattr(monitor_mod, 'fetch_snapshot',
+                        lambda url, timeout=5.0: snaps[int(url[-1])])
+    fleet = monitor_mod.poll_fleet(['u0', 'u1'])
+    assert fleet['determinism']['status'] == 'diverged'
+    assert fleet['determinism']['first']['key'] == [1]
+
+  def test_monitor_once_json_exposes_ledger_and_verdict(
+      self, monkeypatch, tmp_path, capsys):
+    """The acceptance-criteria path: a live rank with LDDL_LEDGER on,
+    polled by ``lddl-monitor --once --json`` — the fleet payload carries
+    the rank's ledger stream heads and verdict.determinism."""
+    from lddl_tpu import cli
+    from lddl_tpu.telemetry import enable
+    from lddl_tpu.telemetry.server import maybe_start_monitor, stop_monitor
+    monkeypatch.setenv('LDDL_MONITOR', '1')
+    monkeypatch.setenv('LDDL_MONITOR_DIR', str(tmp_path))
+    stop_monitor()
+    enable()
+    led = enable_ledger(directory=str(tmp_path), rank=0)
+    led.record('step', fingerprint_bytes(b's0'), step=0)
+    maybe_start_monitor(rank=0)
+    try:
+      assert cli.lddl_monitor(['--dir', str(tmp_path), '--once',
+                               '--json']) == 0
+      fleet = json.loads(capsys.readouterr().out)
+      snap = fleet['ranks']['0']
+      assert snap['ledger']['step']['count'] == 1
+      assert snap['verdict']['determinism']['status'] == 'ok'
+    finally:
+      stop_monitor()
+      disable_ledger()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: 2-rank loader run, injected corruption, bisection
+
+
+class TestCorruptBisection:
+
+  def _drain_rank(self, tmp_path, rank):
+    from lddl_tpu.loader.workers import MultiprocessLoader
+    ledger_mod._active = None
+    enable_ledger(directory=str(tmp_path / 'ledgers'), rank=rank)
+    loader = MultiprocessLoader(
+        dict(batch_size=4, seq_len=16, steps=5), num_workers=1,
+        factory=('lddl_tpu.testing', 'get_synthetic_batch_loader'),
+        transport='shm', slot_bytes=1 << 20)
+    batches = list(loader)
+    disable_ledger()
+    return batches
+
+  def test_flipped_byte_bisected_to_exact_batch(self, tmp_path,
+                                                monkeypatch, capsys):
+    """Two data-parallel rank runs over the identical synthetic stream;
+    rank 1's third collate is damaged by the ledger.corrupt fault (one
+    byte XORed inside the shm slot, exactly like bad hardware). The
+    audit must bisect to collate (epoch 0, index 2) — and the damaged
+    batch really is damaged, not just mis-hashed."""
+    clean = self._drain_rank(tmp_path, 0)
+    monkeypatch.setenv('LDDL_FAULTS', 'corrupt:ledger.corrupt:rank=1,nth=3')
+    faults.reset()
+    damaged = self._drain_rank(tmp_path, 1)
+    monkeypatch.delenv('LDDL_FAULTS')
+
+    assert len(clean) == len(damaged) == 5
+    for i in (0, 1, 3, 4):
+      assert all(np.array_equal(clean[i][k], damaged[i][k])
+                 for k in clean[i])
+    assert not np.array_equal(clean[2]['input_ids'],
+                              damaged[2]['input_ids'])
+
+    d = str(tmp_path / 'ledgers')
+    p0, p1 = ledger_file_name(d, 0), ledger_file_name(d, 1)
+    assert audit.main(['diff', p0, p1]) == 1
+    out = capsys.readouterr().out
+    assert 'collate' in out and 'first divergence' in out
+    result = audit.audit_diff(audit.load_run(p0), audit.load_run(p1))
+    assert result['first']['boundary'] == 'collate'
+    assert result['first']['key'] == {'epoch': 0, 'index': 2}
+    finding = result['ranks'][0][0]
+    assert finding['mismatched_keys'] == 1 and finding['common_keys'] == 5
+
+  def test_clean_ranks_audit_consistent(self, tmp_path):
+    self._drain_rank(tmp_path, 0)
+    self._drain_rank(tmp_path, 1)
+    d = str(tmp_path / 'ledgers')
+    assert audit.main(['diff', ledger_file_name(d, 0),
+                       ledger_file_name(d, 1)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# overhead: the enabled hot path stays cheap
+
+
+class TestOverhead:
+
+  def test_record_cost_bounded(self, tmp_path):
+    """Honest numbers live in PERF.md; this guards against accidental
+    hot-path regressions (json.dumps per record, fsync per record)
+    with a bound ~50x the measured cost so CI noise never trips it."""
+    led = Ledger(directory=str(tmp_path), rank=0)
+    digest = fingerprint_bytes(b'warm')
+    n = 2000
+    led.record('collate', digest, epoch=0, index=-1)  # warm the stream
+    t0 = time.perf_counter()
+    for i in range(n):
+      led.record('collate', digest, epoch=0, index=i)
+    per_record = (time.perf_counter() - t0) / n
+    led.close()
+    assert per_record < 250e-6, f'record() cost {per_record * 1e6:.1f}us'
+
+  def test_fingerprint_cost_bounded(self):
+    batch = {'input_ids': np.zeros((8, 512), np.int32),
+             'attention_mask': np.ones((8, 512), np.int32)}
+    fingerprint_batch(batch)  # warm
+    t0 = time.perf_counter()
+    for _ in range(50):
+      fingerprint_batch(batch)
+    per_batch = (time.perf_counter() - t0) / 50
+    assert per_batch < 5e-3, f'fingerprint cost {per_batch * 1e3:.2f}ms'

@@ -103,12 +103,39 @@ class TestDiffSnapshotLines:
              if l['kind'] == 'histogram')
     assert h['count'] == 0 and 'min' not in h and 'max' not in h
 
-  def test_negative_delta_clamps(self):
+  def test_negative_delta_reanchors_at_restart(self):
+    # A counter running backwards means the rank restarted and its
+    # registry reset: the 2 events it has counted all happened since
+    # the restart (inside this window), so they pass through as the
+    # delta instead of clamping to 0 — a zero rate here is what used to
+    # turn a freshly-recovered rank into a false inf straggler score.
     d = diff_snapshot_lines([_meta(10.0), _counter('c', 100)],
                             [_meta(5.0), _counter('c', 2)])
     meta = next(l for l in d if l['kind'] == 'meta')
-    assert meta['window_sec'] == 0.0
-    assert next(l for l in d if l['kind'] == 'counter')['total'] == 0
+    assert meta['window_sec'] == 0.0  # clocks from different boots
+    c = next(l for l in d if l['kind'] == 'counter')
+    assert c['total'] == 2 and c['reset'] is True
+
+  def test_histogram_reset_reanchors(self):
+    old = [_meta(0.0), _hist('h', count=50, total_sec=5.0)]
+    new = [_meta(10.0), _hist('h', count=3, total_sec=0.3)]
+    d = diff_snapshot_lines(old, new)
+    h = next(l for l in d if l['kind'] == 'histogram')
+    # The since-restart capture passes through whole.
+    assert h['count'] == 3 and h['reset'] is True
+    assert h['sum'] == pytest.approx(0.3)
+
+  def test_restarted_rank_rate_stays_finite(self):
+    # Same-host restart: monotonic keeps advancing, the counter resets.
+    # The re-anchored delta yields a real (small) rate, so the fleet's
+    # straggler table sees a slow-but-alive rank, not an inf verdict.
+    w = SnapshotWindow()
+    w.push([_meta(0.0), _counter('pipeline.encode.tasks', 1000)])
+    w.push([_meta(10.0), _counter('pipeline.encode.tasks', 20)])
+    sig = rank_signals(w)
+    assert sig['tasks_per_sec'] == pytest.approx(2.0)
+    scores = straggler_scores({0: sig, 1: {'tasks_per_sec': 4.0}})
+    assert all(math.isfinite(s) for s in scores['scores'].values())
 
 
 class TestSnapshotWindow:

@@ -185,3 +185,92 @@ class TestGateCli:
     with open(os.path.join(REPO_ROOT, 'setup.py')) as f:
       setup_src = f.read()
     assert 'lddl-perf=lddl_tpu.telemetry.perf:main' in setup_src
+
+
+# ---------------------------------------------------------------------------
+# --audit: one CI command gating perf + determinism
+
+
+def _write_ledger(directory, rank, streams):
+  """streams: [(boundary, payloads)] — fingerprint each payload and
+  record it under a lineage key, via the real Ledger writer so the file
+  format stays honest."""
+  from lddl_tpu.telemetry.ledger import Ledger, fingerprint_bytes
+  led = Ledger(directory=str(directory), rank=rank)
+  for boundary, payloads in streams:
+    for i, payload in enumerate(payloads):
+      key = {'step': i} if boundary == 'step' else {'epoch': 0, 'index': i}
+      led.record(boundary, fingerprint_bytes(payload), **key)
+  led.close()
+  return str(directory)
+
+
+class TestAuditFold:
+
+  def _history(self, tmp_path, values=(10.0, 10.1, 9.9, 10.05, 10.0)):
+    _write_history(tmp_path / 'bench_history.jsonl', list(values))
+
+  def test_matching_runs_pass_combined_gate(self, tmp_path, capsys):
+    self._history(tmp_path)
+    run = _write_ledger(tmp_path / 'run', 0,
+                        [('collate', [b'a', b'b', b'c'])])
+    ref = _write_ledger(tmp_path / 'ref', 0,
+                        [('collate', [b'a', b'b', b'c'])])
+    assert main(['--root', str(tmp_path), '--gate',
+                 '--audit', run, ref]) == 0
+    assert 'determinism audit ok' in capsys.readouterr().out
+
+  def test_divergent_ledger_fails_gate_despite_healthy_perf(
+      self, tmp_path, capsys):
+    self._history(tmp_path)  # perf leg alone would pass
+    run = _write_ledger(tmp_path / 'run', 0,
+                        [('collate', [b'a', b'b', b'c'])])
+    ref = _write_ledger(tmp_path / 'ref', 0,
+                        [('collate', [b'a', b'X', b'c'])])
+    assert main(['--root', str(tmp_path), '--gate',
+                 '--audit', run, ref]) == 1
+    assert 'index=1' in capsys.readouterr().out  # audit findings printed
+
+  def test_audit_without_gate_reports_but_exits_zero(self, tmp_path):
+    self._history(tmp_path)
+    run = _write_ledger(tmp_path / 'run', 0, [('collate', [b'a'])])
+    ref = _write_ledger(tmp_path / 'ref', 0, [('collate', [b'Z'])])
+    assert main(['--root', str(tmp_path), '--audit', run, ref]) == 0
+
+  def test_perf_regression_wins_over_audit_code(self, tmp_path):
+    # Both legs fire; the exit code is perf's 1, not audit's 2.
+    _write_history(tmp_path / 'bench_history.jsonl',
+                   [10.0, 10.1, 9.9, 10.05, 3.0])
+    assert main(['--root', str(tmp_path), '--gate',
+                 '--audit', str(tmp_path / 'absent')]) == 1
+
+  def test_single_path_self_checks_wire(self, tmp_path, capsys):
+    from lddl_tpu.telemetry.ledger import Ledger, fingerprint_bytes
+    self._history(tmp_path)
+    led = Ledger(directory=str(tmp_path / 'run'), rank=0)
+    for gi in range(3):
+      led.record('serve.tx', fingerprint_bytes(b'%d' % gi), epoch=0, gi=gi)
+      rx = b'%d' % gi if gi != 1 else b'damaged'
+      led.record('serve.rx', fingerprint_bytes(rx), epoch=0, gi=gi)
+    led.close()
+    assert main(['--root', str(tmp_path), '--gate',
+                 '--audit', str(tmp_path / 'run')]) == 1
+    assert 'wire' in capsys.readouterr().out
+
+  def test_three_audit_paths_usage_error(self, tmp_path, capsys):
+    self._history(tmp_path)
+    assert main(['--root', str(tmp_path), '--gate',
+                 '--audit', 'a', 'b', 'c']) == 2
+    assert '--audit takes' in capsys.readouterr().err
+
+  def test_json_carries_audit_exit(self, tmp_path, capsys):
+    self._history(tmp_path)
+    run = _write_ledger(tmp_path / 'run', 0, [('step', [b'a', b'b'])])
+    ref = _write_ledger(tmp_path / 'ref', 0, [('step', [b'a', b'b'])])
+    assert main(['--root', str(tmp_path), '--json',
+                 '--audit', run, ref]) == 0
+    # The audit leg prints its findings first; the verdict JSON starts at
+    # the indent=2 opening brace.
+    out = capsys.readouterr().out
+    payload = json.loads(out[out.index('{\n  "verdicts"'):])
+    assert payload['audit_exit'] == 0
