@@ -118,6 +118,18 @@ def _ledger_enabled():
     return False
 
 
+def _replay_stamp():
+  """Replay-capability stamp: whether this build can rematerialize a
+  recorded coordinate (lddl-replay present) and the repro-bundle format
+  version it writes — a BENCH line names the bundle format its ledger
+  coordinates are replayable under."""
+  try:
+    from lddl_tpu.replay import BUNDLE_VERSION
+    return {'available': True, 'bundle_version': BUNDLE_VERSION}
+  except Exception:
+    return {'available': False, 'bundle_version': None}
+
+
 def _reference_style_partition(lines, hf_tok, vocab_words, seed,
                                duplicate_factor=5):
   """The reference's per-partition hot loop, reimplemented faithfully:
@@ -269,6 +281,10 @@ def main():
         # PERF.md "Determinism ledger overhead"). A BENCH line captured
         # with the ledger on is not comparable against one with it off.
         'ledger': _ledger_enabled(),
+        # Deterministic-replay capability of this build (lddl-replay +
+        # bundle format version): names the replay contract the ledger
+        # coordinates in this line are executable under.
+        'replay': _replay_stamp(),
         # Attention masking regime of the training stack this build feeds:
         # 'full' (whole packed row attends to itself) vs 'block_diagonal'
         # (per-doc segment ids, cross-doc tiles skipped) — LDDL_BENCH_

@@ -36,6 +36,11 @@ fork's CodeBERT wrapper), all thin delegates:
                                     tolerant network batch service:
                                     serve one loader's deterministic
                                     stream to N lease-claiming clients)
+  lddl_replay                    -> lddl_tpu.replay.cli (deterministic
+                                    time-travel: rematerialize any
+                                    recorded batch or train step from
+                                    the ledger; hermetic repro bundles;
+                                    loss-spike bisection)
 
 Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
 installed console scripts.
@@ -139,6 +144,11 @@ def lddl_data_server(args=None):
   return main(args)
 
 
+def lddl_replay(args=None):
+  from .replay.cli import main
+  return main(args)
+
+
 _COMMANDS = {
     'download_wikipedia': download_wikipedia,
     'download_books': download_books,
@@ -167,6 +177,8 @@ _COMMANDS = {
     'lddl-audit': lddl_audit,  # dash-form alias
     'lddl_data_server': lddl_data_server,
     'lddl-data-server': lddl_data_server,  # dash-form alias
+    'lddl_replay': lddl_replay,
+    'lddl-replay': lddl_replay,  # dash-form alias
 }
 
 

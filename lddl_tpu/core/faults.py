@@ -48,7 +48,11 @@ send, both ends — raise-specs break the wire mid-stream),
 ``ledger.corrupt`` (:func:`corrupt_bytes` on a packed batch after the
 producer hashed it — loader parent and data-service server — the
 silent-data-corruption drill the determinism ledger's auditor is
-proven against). ``inject()`` is a no-op (one env read) when
+proven against), ``replay.read`` (:func:`corrupt_bytes` on a repro
+bundle's packed payload as ``lddl-replay`` loads it — proves a damaged
+bundle is rejected with the mismatch named at its exact coordinate),
+``replay.step`` (replay step re-execution entry, before each replayed
+train step). ``inject()`` is a no-op (one env read) when
 ``LDDL_FAULTS`` is unset, so production paths pay nothing measurable.
 """
 

@@ -289,6 +289,55 @@ class BertPretrainLoader:
   def samples_per_epoch(self):
     return sum(d.total_samples_per_epoch for d in self._datasets)
 
+  @property
+  def batches_per_epoch(self):
+    """Batches one full epoch yields on this rank (drop-last)."""
+    return sum(d.samples_per_rank_per_epoch // self._batch
+               for d in self._datasets)
+
+  def seek(self, epoch, batch_index):
+    """Position the loader at ledger coordinate ``(epoch, batch_index)``.
+
+    The next ``__iter__``/``iter_steps`` resumes epoch ``epoch`` with
+    batch ``batch_index`` as its first step — collate step counters and
+    dynamic-mask Philox keys line up with the ledger's collate key
+    ``(epoch, index=batch_index)``. This is the one positioning contract
+    shared by elastic resume (:mod:`lddl_tpu.training.elastic`), the
+    data-service degraded fallback (:mod:`lddl_tpu.loader.service`) and
+    :mod:`lddl_tpu.replay`; poking ``_batches_consumed`` directly is
+    deprecated. Returns ``self`` for chaining.
+
+    A mid-epoch seek carries *resume* semantics: the skipped draws
+    reposition the datasets but the shuffle buffer restarts fresh, so
+    batch contents are not byte-identical to the uninterrupted stream
+    (loader/binned.py). Byte-exact rematerialization seeks to
+    ``(epoch, 0)`` and drives the full draw sequence — what
+    :func:`lddl_tpu.replay.rematerialize_batch` does.
+    """
+    epoch, batch_index = int(epoch), int(batch_index)
+    if epoch < 0 or batch_index < 0:
+      raise ValueError(f'seek({epoch}, {batch_index}): coordinates must '
+                       'be non-negative')
+    full = self.batches_per_epoch
+    if batch_index > full:  # == full is a valid position (epoch drained)
+      raise ValueError(f'seek({epoch}, {batch_index}): epoch has only '
+                       f'{full} batches on this rank')
+    self.epoch = epoch
+    self._batches_consumed = batch_index
+    return self
+
+  def tell(self):
+    """``(epoch, batch_index)`` the next iteration starts from — the
+    inverse of :meth:`seek`."""
+    return self.epoch, self._batches_consumed
+
+  def coordinate_of_batch(self, ordinal):
+    """Collate key ``(epoch, index)`` of this rank's ``ordinal``-th batch
+    since the run began — the ledger coordinate a given global train
+    step consumed (one batch per rank per step)."""
+    full = self.batches_per_epoch
+    return ordinal // full, ordinal % full
+
   def _make_iterator(self):
     it = BinnedIterator(
         self._datasets,

@@ -81,6 +81,10 @@ class BinnedIterator:
 
     ``samples_seen`` counts global samples consumed since training start
     (reference ``torch_mp/bert.py:426-456`` computes the same split).
+    The result is exactly the coordinate the public
+    :meth:`~lddl_tpu.loader.bert.BertPretrainLoader.seek` contract takes
+    — this arithmetic is the bridge between the trainer's sample counter
+    and the ledger's ``(epoch, index)`` collate keys.
     """
     global_batch = samples_per_batch_per_rank * dp_world_size
     batches_per_epoch = sum(

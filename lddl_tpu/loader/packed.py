@@ -150,7 +150,10 @@ def get_packed_pretrain_data_loader(
   (same sharding, binning, resume, and worker-process semantics); only
   the collate differs. Defaults are long-context-appropriate: small
   batches, seq alignment 128 (ring/flash block multiples), smaller
-  shuffle buffer (rows are 64-256x BERT-row-sized).
+  shuffle buffer (rows are 64-256x BERT-row-sized). The returned loader
+  carries the same public ``seek(epoch, batch_index)``/``tell()``
+  positioning contract as the BERT loader, so :mod:`lddl_tpu.replay`
+  rematerializes packed coordinates identically.
   """
   if num_workers:
     build_kwargs = {k: v for k, v in locals().items() if k != 'num_workers'}

@@ -902,8 +902,7 @@ class NetworkBatchSource:
     deterministic position, until the epoch completes or the server
     answers again."""
     loader = self._local_loader()
-    loader.epoch = epoch
-    loader._batches_consumed = state.frontier
+    loader.seek(epoch, state.frontier)
     probe_every = reattach_every()
     n = 0
     last = state.frontier - 1
@@ -964,8 +963,7 @@ class NetworkBatchSource:
     from .workers import _resolve_factory
     wanted = set(gis)
     loader = _resolve_factory(self._factory)(**self._kwargs)
-    loader.epoch = epoch
-    loader._batches_consumed = min(wanted)
+    loader.seek(epoch, min(wanted))
     for step, batch in loader.iter_steps((0, 1)):
       if step in wanted:
         yield step, batch

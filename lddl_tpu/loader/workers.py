@@ -129,7 +129,7 @@ def _export_worker_telemetry(tele, rank):
     pass  # export is advisory; never kill a worker over it
 
 
-def _worker_main(build_kwargs, factory, epoch, clear_consumed, w,
+def _worker_main(build_kwargs, factory, epoch, first_step, w,
                  num_workers, q, free_q, ring_desc):
   tele = get_telemetry()
   tracer = get_tracer()
@@ -146,9 +146,11 @@ def _worker_main(build_kwargs, factory, epoch, clear_consumed, w,
     wait_h = tele.histogram('loader.shm_wait_seconds')
     occupancy_g = tele.gauge('loader.shm_slot_occupancy')
     loader = _resolve_factory(factory)(**build_kwargs)
-    loader.epoch = epoch
-    if clear_consumed:
-      loader._batches_consumed = 0
+    # Position via the public contract, at the offset the *parent*
+    # observed — whether it came baked into the factory kwargs
+    # (samples_seen) or from a parent-side seek(); the freshly built
+    # loader here knows only about the former.
+    loader.seek(epoch, first_step)
     for step, batch in loader.iter_steps((w, num_workers)):
       if ring is None:
         q.put(('batch', step, batch))
@@ -270,6 +272,24 @@ class MultiprocessLoader:
   def epoch(self, value):
     self._serial.epoch = value
 
+  @property
+  def batches_per_epoch(self):
+    return self._serial.batches_per_epoch
+
+  def seek(self, epoch, batch_index):
+    """Position the next iteration at collate key ``(epoch,
+    batch_index)`` — delegates to the serial loader, which owns resume
+    state for every transport (see :meth:`lddl_tpu.loader.bert.
+    BertPretrainLoader.seek`). Returns ``self``."""
+    self._serial.seek(epoch, batch_index)
+    return self
+
+  def tell(self):
+    return self._serial.tell()
+
+  def coordinate_of_batch(self, ordinal):
+    return self._serial.coordinate_of_batch(ordinal)
+
   def _get(self, q, proc, w, stall_hist):
     """Queue get that fails fast (naming the worker) on a dead producer
     instead of blocking forever — a hard-killed worker sends no
@@ -295,9 +315,8 @@ class MultiprocessLoader:
     position, so a degraded client (or the next epoch) resumes at the
     exact deterministic step."""
     from .service import NetworkBatchSource
-    epoch = self._serial.epoch
-    first_step = self._serial._batches_consumed
-    self._serial._batches_consumed = 0
+    epoch, first_step = self._serial.tell()
+    self._serial.seek(epoch, 0)
     if self._net_source is None:
       self._net_source = NetworkBatchSource(
           build_kwargs=self._kwargs, factory=self._factory,
@@ -318,13 +337,11 @@ class MultiprocessLoader:
     if self._transport == 'network':
       yield from self._iter_network()
       return
-    epoch = self._serial.epoch
-    first_step = self._serial._batches_consumed
-    clear_consumed = first_step == 0
+    epoch, first_step = self._serial.tell()
     # Mirror the serial loader exactly: it clears the resume offset the
     # moment an iteration starts (bert.py _make_iterator), so len() of an
     # abandoned-then-restarted epoch reports the full count either way.
-    self._serial._batches_consumed = 0
+    self._serial.seek(epoch, 0)
     tele = get_telemetry()
     tracer = get_tracer()
     ledger = get_ledger()
@@ -345,7 +362,7 @@ class MultiprocessLoader:
     procs = [
         ctx.Process(
             target=_worker_main,
-            args=(self._kwargs, self._factory, epoch, clear_consumed, w,
+            args=(self._kwargs, self._factory, epoch, first_step, w,
                   W, queues[w], free_qs[w], ring_descs[w]),
             daemon=True) for w in range(W)
     ]

@@ -1,0 +1,39 @@
+"""Deterministic time-travel: make the determinism ledger *executable*.
+
+PR 15's ledger records a fingerprint lineage (shard → collate → serve →
+device → step) at every pipeline boundary; this package rematerializes
+any recorded coordinate on demand — the one-command-reproduction end
+state reproducible-pipeline work argues for (PAPERS.md 2604.21275):
+
+- :mod:`.rematerialize` — drive the loaders' public
+  ``seek(epoch, batch_index)`` contract to rebuild exactly the batch a
+  ledger line fingerprinted, and verify the reconstruction against the
+  recorded digest;
+- :mod:`.bundle` — hermetic repro bundles: packed batch bytes +
+  RNG/Philox inputs + checkpoint ref + ledger excerpt, replayable on a
+  machine that has never seen the corpus;
+- :mod:`.steps` — re-execute a recorded train step (checkpoint restore
+  at ``S - 1`` + one jitted step through the
+  :class:`~lddl_tpu.training.pretrain.CompiledStepCache`) and diff the
+  resulting state fingerprint against the ledger's ``step`` record;
+  ``bisect`` walks a step window and attributes a loss spike to the
+  batch (and optionally the sample) that moved it;
+- :mod:`.cli` — the ``lddl-replay`` console entry tying it together.
+"""
+
+from .bundle import BUNDLE_VERSION, read_bundle, write_bundle
+from .rematerialize import (ReplayMismatch, format_coordinate,
+                            lookup_digest, rematerialize_batch,
+                            replay_coordinate, replay_smoke)
+
+__all__ = [
+    'BUNDLE_VERSION',
+    'ReplayMismatch',
+    'format_coordinate',
+    'lookup_digest',
+    'read_bundle',
+    'rematerialize_batch',
+    'replay_coordinate',
+    'replay_smoke',
+    'write_bundle',
+]

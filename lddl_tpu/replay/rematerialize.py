@@ -1,0 +1,211 @@
+"""Rebuild a recorded batch by replaying the deterministic draw sequence.
+
+Every batch coordinate the ledger records — a ``(epoch, index)`` collate
+key or an ``(epoch, gi)`` serve frame — names a position in a loader's
+deterministic stream: same shards, same seed, same draw sequence.
+Rematerialization is *build the loader the run used, replay its epoch's
+draw sequence to the coordinate, take that batch* — then prove the
+reconstruction by fingerprinting it with the ledger's own digest
+arithmetic and comparing against the recorded line. (The loaders'
+public ``seek`` contract positions the stream at the epoch start; the
+mid-epoch skip path is the *resume* contract, whose shuffle buffer
+restarts fresh and is deliberately not byte-identical.)
+
+Serve frames replay through the same path: the data service's global
+index ``gi`` is the serial step of the server's loader for the epoch
+(service.py's degraded fallback re-derives batches from exactly this
+``f(epoch, gi)`` identity), so replaying to step ``gi`` on a loader
+built from the server's spec reproduces the frame that crossed the
+wire.
+"""
+
+import random
+
+
+class ReplayMismatch(ValueError):
+  """A reconstructed artifact's fingerprint differs from the recorded
+  one — raised with the exact coordinate in the message."""
+
+
+def format_coordinate(coord):
+  """``{'epoch': 0, 'index': 3}`` -> ``'epoch=0, index=3'`` (the
+  rendered-key grammar of ``lddl-audit``)."""
+  return ', '.join(f'{f}={v}' for f, v in dict(coord).items())
+
+
+def _check_algo(run):
+  """Refuse to verify against a run hashed with an algorithm this
+  process cannot reproduce (xxh64 ledger, blake2b8-only host)."""
+  from ..telemetry.audit import run_algo
+  from ..telemetry.ledger import ALGO
+  algo = run_algo(run)
+  if algo and algo != ALGO:
+    raise ValueError(
+        f'ledger was hashed with {algo} but this process fingerprints '
+        f'with {ALGO}; reconstruction cannot be verified here')
+  return algo or ALGO
+
+
+def lookup_digest(run, key, boundary=None):
+  """The single digest recorded at ``key`` in ``run`` (a
+  :func:`~lddl_tpu.telemetry.audit.load_run` dict). Raises
+  :class:`LookupError` when the coordinate was never recorded and
+  :class:`ReplayMismatch` when the run recorded *conflicting* digests
+  for it (the coordinate is not trustworthy enough to replay against).
+  Returns ``(digest, [(rank, record), ...])``."""
+  from ..telemetry.audit import lookup_records
+  hits = lookup_records(run, key, boundary=boundary)
+  if not hits:
+    where = f' at boundary {boundary}' if boundary else ''
+    raise LookupError(
+        f'no ledger record at ({format_coordinate(key)}){where}')
+  digests = sorted({rec['digest'] for _, rec in hits})
+  if len(digests) > 1:
+    raise ReplayMismatch(
+        f'ledger records conflicting digests at '
+        f'({format_coordinate(key)}): {digests} — run lddl-audit first')
+  return digests[0], hits
+
+
+def rematerialize_batch(factory, build_kwargs, epoch, index):
+  """Build the loader ``factory(**build_kwargs)`` names, drive its
+  epoch-``epoch`` draw sequence from batch 0, and return the batch at
+  collate coordinate ``(epoch, index)``.
+
+  Driving from the epoch start — not ``seek(epoch, index)`` — is what
+  makes the reconstruction byte-identical: seek's skip contract
+  repositions the datasets but restarts the shuffle buffer fresh (the
+  documented resume semantics, loader/binned.py), which reorders rows
+  relative to the uninterrupted stream that produced the ledger line.
+  The cost stays one collate, not ``index`` of them: ``iter_steps``'s
+  worker-sharding contract advances the full deterministic row stream
+  but collates only the shard's steps, and shard ``(index, index+1)``
+  collates ``index`` first.
+
+  The factory is the same ``(module, attr)`` spec the worker/service
+  layers use, so any loader a run can be fed from can be replayed from
+  — synthetic included.
+  """
+  from ..loader.workers import _resolve_factory
+  loader = _resolve_factory(tuple(factory))(**build_kwargs)
+  index = int(index)
+  loader.seek(int(epoch), 0)
+  for step, batch in loader.iter_steps((index, index + 1)):
+    return batch  # the first collated step IS `index`
+  raise LookupError(
+      f'loader produced no batch at epoch={epoch}, index={index} '
+      '(dataset shorter than the recorded run?)')
+
+
+#: Boundaries whose coordinates name a batch position this module can
+#: rematerialize. ``serve.*`` keys are ``(epoch, gi)`` and gi is the
+#: serial step; ``collate`` keys are ``(epoch, index)`` directly.
+REPLAYABLE_BOUNDARIES = ('collate', 'serve.tx', 'serve.rx')
+
+
+def _batch_position(key):
+  """Map a lineage key tuple to the ``(epoch, batch_index)`` seek
+  target, or None for boundaries with no batch position (shard paths,
+  device frames, train steps)."""
+  d = dict(key)
+  if 'epoch' in d and 'index' in d:
+    return d['epoch'], d['index']
+  if 'epoch' in d and 'gi' in d:
+    return d['epoch'], d['gi']
+  return None
+
+
+def replay_coordinate(ledger_path, key, factory, build_kwargs,
+                      boundary=None, rank=None):
+  """Rematerialize the batch at ``key`` and verify it against the
+  ledger at ``ledger_path``.
+
+  Returns a result dict (coordinate, boundary, recorded / reconstructed
+  digests, match verdict, algo). Raises :class:`LookupError` for an
+  unrecorded coordinate, :class:`ValueError` for an algorithm the host
+  cannot reproduce, and :class:`ReplayMismatch` is **not** raised here
+  — mismatch is a verdict, so CI callers can render it; use
+  ``result['match']``.
+  """
+  from ..telemetry.audit import load_run
+  from ..telemetry.ledger import fingerprint_batch
+  run = load_run(ledger_path, rank=rank)
+  algo = _check_algo(run)
+  digest, hits = lookup_digest(run, tuple(key), boundary=boundary)
+  pos = _batch_position(tuple(key))
+  if pos is None:
+    raise ValueError(
+        f'coordinate ({format_coordinate(key)}) has no batch position; '
+        "replay batch coordinates are (epoch, index) or (epoch, gi) — "
+        "use 'lddl-replay step' for step coordinates")
+  batch = rematerialize_batch(factory, build_kwargs, *pos)
+  actual = fingerprint_batch(batch)
+  return {
+      'coordinate': dict(tuple(key)),
+      'boundary': boundary or hits[0][1]['boundary'],
+      'recorded': digest,
+      'reconstructed': actual,
+      'match': actual == digest,
+      'algo': algo,
+      'batch': batch,
+  }
+
+
+def replay_smoke(ledger_path, factory, build_kwargs, seed=0, rank=None):
+  """One random recorded coordinate per boundary, replayed and verified
+  — the ``lddl-perf --replay-smoke`` gate.
+
+  Batch-position boundaries (:data:`REPLAYABLE_BOUNDARIES`) are
+  rematerialized through :func:`rematerialize_batch`; boundaries with
+  no batch position (``shard``/``device``/``step``) are reported
+  ``skipped`` — they need the original shard files or a checkpoint, not
+  just the loader spec. Returns ``(results, rc)`` where ``rc`` is 0
+  when every replayed coordinate matched (skips don't fail) and 1 on
+  any mismatch. Deterministic under ``seed``.
+  """
+  from ..telemetry.audit import load_run
+  from ..telemetry.ledger import fingerprint_batch, record_key
+  run = load_run(ledger_path, rank=rank)
+  _check_algo(run)
+  rnd = random.Random(seed)
+  by_boundary = {}
+  for r in sorted(run):
+    for rec in run[r]['records']:
+      k = record_key(rec)
+      if k is not None:
+        by_boundary.setdefault(rec['boundary'], {})[(r, k)] = rec
+  results, rc = {}, 0
+  for bd in sorted(by_boundary):
+    table = by_boundary[bd]
+    if bd not in REPLAYABLE_BOUNDARIES:
+      results[bd] = {'status': 'skipped',
+                     'reason': 'no batch position (needs shards or a '
+                               'checkpoint, not a loader spec)'}
+      continue
+    rec_rank, key = rnd.choice(sorted(table))
+    pos = _batch_position(key)
+    if pos is None:
+      results[bd] = {'status': 'skipped', 'reason': 'incomplete key'}
+      continue
+    # Collate records are per-dp-rank streams: rebuild *that* rank's
+    # loader. Serve frames come off the server's single loader, so the
+    # spec is used as-is.
+    kwargs = dict(build_kwargs)
+    if bd == 'collate':
+      kwargs.setdefault('dp_rank', rec_rank)
+    try:
+      batch = rematerialize_batch(factory, kwargs, *pos)
+    except Exception as e:  # an unreplayable spec is a failed smoke
+      results[bd] = {'status': 'error', 'coordinate': dict(key),
+                     'error': f'{type(e).__name__}: {e}'}
+      rc = 1
+      continue
+    actual = fingerprint_batch(batch)
+    recorded = table[(rec_rank, key)]['digest']
+    ok = actual == recorded
+    results[bd] = {'status': 'ok' if ok else 'mismatch',
+                   'coordinate': dict(key), 'rank': rec_rank,
+                   'recorded': recorded, 'reconstructed': actual}
+    if not ok:
+      rc = 1
+  return results, rc

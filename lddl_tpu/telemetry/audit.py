@@ -142,6 +142,51 @@ def _fmt_key(key):
   return '(' + ', '.join(f'{f}={v}' for f, v in key) + ')'
 
 
+def parse_key(spec):
+  """Parse a lineage-key spec string into the canonical key tuple
+  :func:`~.ledger.record_key` produces.
+
+  The grammar is the rendered key form without the parens:
+  ``"epoch=0,index=3"`` (a collate coordinate), ``"epoch=1,gi=7"`` (a
+  serve frame), ``"step=42"`` (a train step), ``"path=shard-00.parquet"``
+  (a shard). Field order is normalized to :data:`~.ledger.KEY_FIELDS`;
+  every field but ``path`` is coerced to int. This is the shared
+  coordinate grammar of ``lddl-audit show --key`` and ``lddl-replay``.
+  """
+  fields = {}
+  for part in str(spec).split(','):
+    part = part.strip()
+    if not part:
+      continue
+    if '=' not in part:
+      raise ValueError(f'bad key spec {spec!r}: expected field=value, '
+                       f'got {part!r}')
+    f, v = part.split('=', 1)
+    f = f.strip()
+    if f not in KEY_FIELDS:
+      raise ValueError(f'bad key spec {spec!r}: unknown field {f!r} '
+                       f'(known: {", ".join(KEY_FIELDS)})')
+    fields[f] = v.strip() if f == 'path' else int(v)
+  if not fields:
+    raise ValueError(f'bad key spec {spec!r}: no fields')
+  return tuple((f, fields[f]) for f in KEY_FIELDS if f in fields)
+
+
+def lookup_records(run, key, boundary=None):
+  """All records in ``run`` (a :func:`load_run` dict) whose lineage key
+  equals ``key``, as ``(rank, record)`` pairs in file order —
+  ``lddl-audit show --key``'s and replay's coordinate-lookup path.
+  ``boundary`` restricts to one boundary name."""
+  out = []
+  for rank in sorted(run):
+    for rec in run[rank]['records']:
+      if boundary is not None and rec['boundary'] != boundary:
+        continue
+      if record_key(rec) == key:
+        out.append((rank, rec))
+  return out
+
+
 def diff_indexed(a, b, boundaries=None):
   """First divergence per boundary between two key-indexed views.
 
@@ -364,6 +409,22 @@ def _cmd_show(args):
   except FileNotFoundError as e:
     print(f'lddl-audit: {e}', file=sys.stderr)
     return 2
+  if getattr(args, 'key', None):
+    # Single-coordinate pull: the replay lookup path on the CLI. Exit 0
+    # with the matching lines, 1 when the coordinate was never recorded.
+    try:
+      key = parse_key(args.key)
+    except ValueError as e:
+      print(f'lddl-audit: {e}', file=sys.stderr)
+      return 2
+    hits = lookup_records(run, key, boundary=args.boundary or None)
+    for rank, rec in hits:
+      print(json.dumps(dict(rec, rank=rank), default=str))
+    if not hits:
+      print(f'lddl-audit: no record at {_fmt_key(key)} in {args.dir}',
+            file=sys.stderr)
+      return 1
+    return 0
   for rank, parsed in sorted(run.items()):
     indexed, conflicts = index_records(parsed)
     algo = parsed['meta'][0].get('algo') if parsed['meta'] else '?'
@@ -404,6 +465,11 @@ def attach_args(parser):
   p = sub.add_parser('show', help='per-boundary summary of one run')
   p.add_argument('dir', help='ledger directory or file')
   p.add_argument('--rank', type=int, default=None)
+  p.add_argument('--key', default=None, metavar='LINEAGE_KEY',
+                 help="pull one coordinate's record lines instead of "
+                      "the summary (e.g. 'epoch=0,index=3', 'step=42')")
+  p.add_argument('--boundary', default=None,
+                 help='with --key: restrict the lookup to one boundary')
   return parser
 
 

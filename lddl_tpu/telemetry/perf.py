@@ -232,6 +232,19 @@ def attach_args(parser):
                            '(lddl-audit verify). Under --gate the audit '
                            'exit code folds into the return code, so one '
                            'command gates perf and determinism.')
+  parser.add_argument('--replay-smoke', action='store_true',
+                      help='with --audit: also replay one random '
+                           'recorded coordinate per ledger boundary '
+                           '(lddl-replay smoke) and fold the verdict '
+                           'into the gate exit code — the audit proves '
+                           'the lineage is consistent, the smoke proves '
+                           'it is still *executable*')
+  parser.add_argument('--replay-factory', default=None,
+                      metavar='MODULE:ATTR',
+                      help='loader factory the smoke rebuilds batches '
+                           'with (default: the synthetic loader)')
+  parser.add_argument('--replay-kwargs-json', default='{}',
+                      help='JSON kwargs for --replay-factory')
   parser.add_argument('--json', action='store_true', dest='as_json',
                       help='emit the full verdict list as JSON')
   return parser
@@ -255,6 +268,33 @@ def run_audit(paths):
   return 2
 
 
+def run_replay_smoke(ledger_path, factory_spec=None, kwargs_json='{}'):
+  """``--replay-smoke``: one random recorded coordinate per boundary,
+  rematerialized and verified against its ledger line (skips
+  boundaries with no batch position). Returns the smoke exit code —
+  0 all replayed coordinates matched, 1 on any mismatch/error."""
+  from lddl_tpu.replay.rematerialize import replay_smoke
+  if factory_spec:
+    module, _, attr = factory_spec.partition(':')
+    factory, kwargs = (module, attr), json.loads(kwargs_json)
+  else:
+    factory = ('lddl_tpu.testing', 'get_synthetic_batch_loader')
+    kwargs = json.loads(kwargs_json)
+  try:
+    results, rc = replay_smoke(ledger_path, factory, kwargs)
+  except (FileNotFoundError, ValueError, LookupError) as e:
+    print(f'lddl-perf: replay smoke failed: {e}', file=sys.stderr)
+    return 2
+  for bd, r in sorted(results.items()):
+    extra = ''
+    if 'coordinate' in r:
+      extra = f' at {r["coordinate"]}'
+    if r['status'] not in ('ok', 'skipped'):
+      extra += f' — {r.get("error", "digest mismatch")}'
+    print(f'lddl-perf: replay-smoke {bd}: {r["status"]}{extra}')
+  return rc
+
+
 def main(argv=None):
   args = attach_args(argparse.ArgumentParser(
       prog='lddl-perf',
@@ -263,6 +303,14 @@ def main(argv=None):
   # Determinism leg first: its findings print even when the perf leg
   # later bails on missing history, so CI logs always show both verdicts.
   audit_rc = run_audit(args.audit) if args.audit else 0
+  if args.replay_smoke:
+    if not args.audit:
+      print('lddl-perf: --replay-smoke requires --audit (the smoke '
+            'replays that ledger)', file=sys.stderr)
+      return 2
+    smoke_rc = run_replay_smoke(args.audit[0], args.replay_factory,
+                                args.replay_kwargs_json)
+    audit_rc = audit_rc or smoke_rc
   series = gather_series(args.root, args.history)
   if not series:
     print(f'lddl-perf: no bench history under {args.root!r} '

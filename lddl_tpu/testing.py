@@ -119,9 +119,9 @@ class SyntheticBatchLoader:
   """A loader-protocol stand-in that replays one precollated batch.
 
   Implements exactly the surface :class:`~lddl_tpu.loader.workers.
-  MultiprocessLoader` drives (``iter_steps``, ``epoch``,
-  ``_batches_consumed``, ``__len__``, ``samples_per_epoch``,
-  ``batch_size``) with a near-zero production cost, so transport
+  MultiprocessLoader` drives (``iter_steps``, ``seek``/``tell``,
+  ``epoch``, ``__len__``, ``samples_per_epoch``, ``batch_size``) with a
+  near-zero production cost, so transport
   microbenchmarks and tests measure the worker→parent handoff itself
   rather than collate throughput.
   """
@@ -149,6 +149,30 @@ class SyntheticBatchLoader:
   @property
   def batch_size(self):
     return self._batch_size
+
+  @property
+  def batches_per_epoch(self):
+    return self._steps
+
+  def seek(self, epoch, batch_index):
+    """Public positioning contract (see
+    :meth:`lddl_tpu.loader.bert.BertPretrainLoader.seek`)."""
+    epoch, batch_index = int(epoch), int(batch_index)
+    if epoch < 0 or batch_index < 0:
+      raise ValueError(f'seek({epoch}, {batch_index}): coordinates must '
+                       'be non-negative')
+    if batch_index > self._steps:  # == steps: epoch drained
+      raise ValueError(f'seek({epoch}, {batch_index}): epoch has only '
+                       f'{self._steps} batches')
+    self.epoch = epoch
+    self._batches_consumed = batch_index
+    return self
+
+  def tell(self):
+    return self.epoch, self._batches_consumed
+
+  def coordinate_of_batch(self, ordinal):
+    return ordinal // self._steps, ordinal % self._steps
 
   @property
   def samples_per_epoch(self):

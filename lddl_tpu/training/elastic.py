@@ -86,6 +86,33 @@ def shed_threshold():
     return 0.0
 
 
+def reseek_loader(loader, samples_seen, dp_world=1):
+  """Position ``loader`` at the global ``samples_seen`` counter via the
+  public ``seek(epoch, batch_index)`` contract.
+
+  The elastic resume path: a reformed fleet restores a checkpoint whose
+  ``samples_seen`` is world-size-independent, and each rank's loader
+  must continue from the matching ``(epoch, batch_index)`` coordinate —
+  the same arithmetic as :meth:`~lddl_tpu.loader.binned.BinnedIterator.
+  epoch_and_offset_of`, expressed against the loader protocol so every
+  seekable loader (bert / packed / multiprocess / synthetic) resumes
+  identically. Poking ``_batches_consumed`` directly is deprecated.
+
+  Returns the ``(epoch, batch_index)`` it seeked to, or None for a
+  loader that carries no positioning contract (raw iterables).
+  """
+  if loader is None or not hasattr(loader, 'seek'):
+    return None
+  global_batch = loader.batch_size * max(int(dp_world), 1)
+  samples_per_epoch = loader.batches_per_epoch * global_batch
+  if samples_per_epoch <= 0:
+    return None
+  epoch = samples_seen // samples_per_epoch
+  index = (samples_seen % samples_per_epoch) // global_batch
+  loader.seek(epoch, index)
+  return epoch, index
+
+
 class AsyncCheckpointWriter(AsyncShardWriter):
   """Background orbax-save lane: the shard writer's overlap-and-flush
   discipline pointed at checkpoints.

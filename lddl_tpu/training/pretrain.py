@@ -148,6 +148,27 @@ def _step_cache_enabled():
       '0', 'false', 'off', 'no')
 
 
+def state_fingerprint(snap):
+  """Content fingerprint of a train-state pytree (params + opt_state +
+  rng key data), the exact digest the ledger's ``step`` boundary
+  records. ``snap`` should be a donation-safe snapshot
+  (:func:`~lddl_tpu.parallel.train.snapshot_for_checkpoint`); multi-host
+  sharded leaves are reduced to their local addressable bytes, identical
+  across runs of the same topology. Module-level so
+  :mod:`lddl_tpu.replay` can diff a re-executed step against the
+  recorded line without a live ledger."""
+  import jax
+  import numpy as np
+
+  from ..telemetry.ledger import fingerprint_batch
+
+  def _host(x):
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+      return np.asarray(x.addressable_data(0))
+    return x
+  return fingerprint_batch(jax.tree_util.tree_map(_host, snap))
+
+
 @dataclasses.dataclass
 class TrainLoop:
   """Owns model/optimizer state, the loader, and the step function."""
@@ -204,7 +225,11 @@ class TrainLoop:
     if block_diagonal and data_format != 'packed':
       raise ValueError("block_diagonal requires data_format='packed' "
                        '(pair shards carry no doc_offsets)')
-    if data_format == 'packed':
+    if path is None:
+      # Loader-free loop: replay feeds batches from a hermetic bundle
+      # (lddl-replay step --bundle), so no corpus is needed on disk.
+      loader = None
+    elif data_format == 'packed':
       # Long-context document-packed shards (preprocess_packed_pretrain):
       # always dynamic masking, no NSP pairs.
       if masking != 'dynamic':
@@ -307,19 +332,9 @@ class TrainLoop:
     the gradient all-reduce, so this is the boundary the cross-rank
     divergence verdict compares by default — and the one that catches a
     resumed/resharded run whose arithmetic drifted from the parent.
-    Multi-host sharded leaves stay on device in the snapshot; they are
-    reduced to their local addressable bytes here (identical across
-    runs of the same topology)."""
-    import jax
-    import numpy as np
-
-    from ..telemetry.ledger import fingerprint_batch
-
-    def _host(x):
-      if isinstance(x, jax.Array) and not x.is_fully_addressable:
-        return np.asarray(x.addressable_data(0))
-      return x
-    digest = fingerprint_batch(jax.tree_util.tree_map(_host, snap))
+    Digest arithmetic lives in the module-level
+    :func:`state_fingerprint` (shared with :mod:`lddl_tpu.replay`)."""
+    digest = state_fingerprint(snap)
     coords = {'step': self.step, 'samples': self.samples_seen}
     if self._last_loss is not None:
       coords['loss'] = self._last_loss
@@ -342,8 +357,11 @@ class TrainLoop:
     mngr.close()
 
   @staticmethod
-  def latest_meta(ckpt_dir):
+  def latest_meta(ckpt_dir, max_step=None):
     """(step, samples_seen) of the newest *readable* checkpoint, or None.
+
+    ``max_step`` bounds the search to steps <= it — replay/bisect
+    restores the newest ancestor of a target step this way.
 
     Robust by design — this is the first call of every restarted rank:
     directory reads retry transient IO errors with the comm layer's
@@ -362,6 +380,8 @@ class TrainLoop:
     try:
       steps = sorted(_retry_io(mngr.all_steps, 'list checkpoint steps'),
                      reverse=True)
+      if max_step is not None:
+        steps = [s for s in steps if s <= max_step]
       for step in steps:
         try:
           meta = mngr.restore(step, args=ocp.args.Composite(
@@ -378,19 +398,22 @@ class TrainLoop:
     finally:
       mngr.close()
 
-  def restore(self, ckpt_dir):
-    """Restore sharded state from the newest checkpoint in ``ckpt_dir``.
+  def restore(self, ckpt_dir, step=None):
+    """Restore sharded state from a checkpoint in ``ckpt_dir``.
 
-    The loader must already have been built with the checkpoint's
-    ``samples_seen`` (use :meth:`latest_meta` before :meth:`build`);
-    this method restores the device state onto the existing shardings.
-    The existing shardings may belong to a *different* mesh than the
-    one the checkpoint was written on — ``build()`` lays the template
-    tree out canonically on whatever mesh the resumed run has, and
-    every restored leaf is re-placed through
-    :func:`~lddl_tpu.parallel.mesh.reshard_pytree`, so world-size-
-    changing resume (2 ranks die, restart on 1; or scale 1 -> 8) is the
-    same code path as same-size resume.
+    ``step=None`` restores the newest step; an explicit ``step``
+    restores that exact checkpoint (the time-travel entry point —
+    ``lddl-replay`` restores ``S - 1`` to re-execute step ``S``). The
+    device state lands on the loop's existing shardings, which may
+    belong to a *different* mesh than the one the checkpoint was written
+    on — ``build()`` lays the template tree out canonically on whatever
+    mesh the resumed run has, and every restored leaf is re-placed
+    through :func:`~lddl_tpu.parallel.mesh.reshard_pytree`, so
+    world-size-changing resume (2 ranks die, restart on 1; or scale
+    1 -> 8) is the same code path as same-size resume. The loader (when
+    the loop has one) is re-seeked to the restored ``samples_seen``
+    through the public positioning contract, so restoring an *older*
+    step also rewinds the data stream.
     """
     import jax
     import orbax.checkpoint as ocp
@@ -398,7 +421,12 @@ class TrainLoop:
     from ..comm.backend import _retry_io
     from ..parallel import reshard_pytree
     mngr = self._manager(ckpt_dir)
-    step = _retry_io(mngr.latest_step, 'find latest checkpoint')
+    if step is None:
+      step = _retry_io(mngr.latest_step, 'find latest checkpoint')
+    elif step not in _retry_io(mngr.all_steps, 'list checkpoint steps'):
+      mngr.close()
+      raise FileNotFoundError(
+          f'no checkpoint for step {step} under {ckpt_dir}')
     if step is None:
       raise FileNotFoundError(f'no checkpoint under {ckpt_dir}')
     target = {'params': self.params, 'opt_state': self.opt_state,
@@ -432,7 +460,19 @@ class TrainLoop:
     self.step = restored['meta']['step']
     self.samples_seen = restored['meta']['samples_seen']
     self._last_saved = self.step  # this step already exists on disk
+    from .elastic import reseek_loader
+    reseek_loader(self.loader, self.samples_seen, self.dp_world)
     return self
+
+  def state_digest(self):
+    """:func:`state_fingerprint` of the loop's live train state — equal
+    to the ledger's ``step`` record when the loop sits at that step."""
+    import jax
+
+    from ..parallel.train import snapshot_for_checkpoint
+    return state_fingerprint(snapshot_for_checkpoint(
+        {'params': self.params, 'opt_state': self.opt_state,
+         'rng': jax.random.key_data(self.rng)}))
 
   # ---- the loop ----
 

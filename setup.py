@@ -45,6 +45,7 @@ setup(
             'lddl-perf=lddl_tpu.telemetry.perf:main',
             'lddl-audit=lddl_tpu.telemetry.audit:main',
             'lddl-data-server=lddl_tpu.loader.service:main',
+            'lddl-replay=lddl_tpu.replay.cli:main',
         ],
     },
 )

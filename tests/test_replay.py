@@ -1,0 +1,386 @@
+"""Deterministic time-travel (``lddl-replay``): any recorded batch or
+train step rematerializes bit-for-bit from its ledger coordinate.
+
+Covers the subsystem's acceptance contract end to end:
+
+- the loaders' public ``seek``/``tell`` positioning contract (the one
+  API elastic resume, the service fallback, and replay now share);
+- batch rematerialization byte-identity against recorded collate keys —
+  on the real binned loader (shuffle buffer active) and across all
+  three multiprocess transports (pickle / shm / network) plus a
+  world-size-2 reshard;
+- hermetic repro bundles: round-trip byte-identity and loud rejection
+  of a corrupted payload at the exact named coordinate
+  (``replay.read`` fault site);
+- step replay: restore checkpoint ``S-1``, re-execute through the
+  jitted step from only the bundle + checkpoint, and reproduce the
+  recorded ``step=S`` state fingerprint bit-for-bit;
+- the ``lddl-audit show --key`` lookup and the ``lddl-perf
+  --replay-smoke`` gate engine.
+"""
+
+import numpy as np
+import pytest
+
+from lddl_tpu.loader.workers import MultiprocessLoader
+from lddl_tpu.replay import (ReplayMismatch, read_bundle,
+                             rematerialize_batch, replay_coordinate,
+                             replay_smoke, write_bundle)
+from lddl_tpu.telemetry import audit
+from lddl_tpu.telemetry.ledger import fingerprint_batch
+
+from test_loader import BIN_SIZE, _mk_loader, binned_shards  # noqa: F401
+from test_training import _loop, _with_ledger
+from test_benchmarks import shards  # noqa: F401  (fixture reuse)
+
+SYNTH = ('lddl_tpu.testing', 'get_synthetic_batch_loader')
+BERT = ('lddl_tpu.loader.bert', 'get_bert_pretrain_data_loader')
+
+
+def _bert_kwargs(binned_shards, tiny_vocab, **kw):
+  base = dict(path=binned_shards, vocab_file=tiny_vocab, dp_rank=0,
+              dp_world_size=1, batch_size_per_rank=8, bin_size=BIN_SIZE,
+              max_seq_length=128, shuffle_buffer_size=16)
+  base.update(kw)
+  return base
+
+
+# ---------------------------------------------------------------------------
+# the public positioning contract
+
+
+def test_seek_tell_contract(binned_shards, tiny_vocab):
+  loader = _mk_loader(binned_shards, tiny_vocab)
+  assert loader.batches_per_epoch == 8
+  assert loader.tell() == (0, 0)
+  assert loader.seek(1, 3) is loader  # chains
+  assert loader.tell() == (1, 3)
+  loader.seek(0, 8)  # == batches_per_epoch: valid drained position
+  with pytest.raises(ValueError, match='epoch has only'):
+    loader.seek(0, 9)
+  with pytest.raises(ValueError, match='non-negative'):
+    loader.seek(-1, 0)
+  assert loader.coordinate_of_batch(11) == (1, 3)
+
+
+def test_seek_equals_samples_seen_resume(binned_shards, tiny_vocab):
+  """seek() is the public spelling of the samples_seen resume position:
+  both paths carry the same resume semantics (same skip draws, same
+  fresh shuffle buffer), so their streams are identical."""
+  resumed = _mk_loader(binned_shards, tiny_vocab, samples_seen=3 * 8)
+  sought = _mk_loader(binned_shards, tiny_vocab).seek(0, 3)
+  assert resumed.tell() == sought.tell() == (0, 3)
+  a = [fingerprint_batch(b) for b in resumed]
+  b = [fingerprint_batch(b) for b in sought]
+  assert len(a) == 5 and a == b
+
+
+def test_multiprocess_loader_delegates_seek():
+  kwargs = dict(batch_size=4, seq_len=16, steps=6)
+  loader = MultiprocessLoader(kwargs, num_workers=1, factory=SYNTH,
+                              transport='pickle')
+  assert loader.batches_per_epoch == 6
+  loader.seek(2, 3)
+  assert loader.tell() == (2, 3)
+  assert loader.coordinate_of_batch(13) == (2, 1)
+  assert len(list(loader)) == 3  # resumes at step 3 of a 6-step epoch
+
+
+# ---------------------------------------------------------------------------
+# batch rematerialization byte-identity
+
+
+def test_rematerialize_exact_under_shuffle(binned_shards, tiny_vocab):
+  """The heart of the subsystem: with a live shuffle buffer, a mid-epoch
+  seek is NOT byte-identical (resume semantics) but rematerialization —
+  which drives the draw sequence from the epoch start — is, at every
+  index."""
+  kw = _bert_kwargs(binned_shards, tiny_vocab)
+  from lddl_tpu.loader.bert import get_bert_pretrain_data_loader
+  fps = [fingerprint_batch(b) for b in get_bert_pretrain_data_loader(**kw)]
+  assert len(fps) == 8
+  for i in (0, 3, 7):
+    got = fingerprint_batch(rematerialize_batch(BERT, kw, 0, i))
+    assert got == fps[i], f'index {i} not byte-identical'
+
+
+def test_replay_coordinate_against_recorded_ledger(binned_shards,
+                                                   tiny_vocab, tmp_path):
+  kw = _bert_kwargs(binned_shards, tiny_vocab)
+
+  def record():
+    from lddl_tpu.loader.bert import get_bert_pretrain_data_loader
+    loader = get_bert_pretrain_data_loader(**kw)
+    for _ in range(2):  # two epochs: replay must honor the epoch field
+      for _ in loader:
+        pass
+  _with_ledger(tmp_path / 'led', 0, record)
+
+  for key in ((('epoch', 0), ('index', 5)), (('epoch', 1), ('index', 2))):
+    res = replay_coordinate(str(tmp_path / 'led'), key, BERT, kw,
+                            boundary='collate')
+    assert res['match'] is True, res
+    assert res['recorded'] == res['reconstructed']
+
+  with pytest.raises(LookupError, match='no ledger record'):
+    replay_coordinate(str(tmp_path / 'led'), (('epoch', 9), ('index', 0)),
+                      BERT, kw, boundary='collate')
+
+
+@pytest.mark.parametrize('transport', ['pickle', 'shm'])
+def test_replay_transport_byte_identity(transport, tmp_path):
+  """Every collate key a multiprocess parent recorded replays
+  byte-identical, whatever transport carried the batch."""
+  kwargs = dict(batch_size=4, seq_len=16, steps=6)
+
+  def record():
+    loader = MultiprocessLoader(dict(kwargs), num_workers=2, factory=SYNTH,
+                                transport=transport)
+    return [fingerprint_batch(b) for b in loader]
+  delivered = _with_ledger(tmp_path / 'led', 0, record)
+  assert len(delivered) == 6
+
+  led = str(tmp_path / 'led')
+  for i in range(6):
+    res = replay_coordinate(led, (('epoch', 0), ('index', i)), SYNTH,
+                            kwargs, boundary='collate')
+    assert res['match'] is True, (transport, i, res)
+    assert res['recorded'] == delivered[i]
+
+
+def test_replay_network_transport_byte_identity(tmp_path, monkeypatch):
+  """The network transport records three replayable boundaries (collate
+  at the client parent, serve.tx on the server, serve.rx on the client);
+  all of them must rematerialize byte-identical from the loader spec."""
+  from lddl_tpu.loader.service import DataServer
+  from lddl_tpu.testing import SyntheticBatchLoader
+  kwargs = dict(batch_size=4, seq_len=16, steps=6)
+
+  def record():
+    srv = DataServer(SyntheticBatchLoader(**kwargs), window=6,
+                     epochs=1).start()
+    monkeypatch.setenv('LDDL_DATA_SERVER', srv.url)
+    try:
+      loader = MultiprocessLoader(dict(kwargs), num_workers=0,
+                                  transport='network', factory=SYNTH)
+      return [fingerprint_batch(b) for b in loader]
+    finally:
+      srv.stop()
+  delivered = _with_ledger(tmp_path / 'led', 0, record)
+  assert len(delivered) == 6
+
+  led = str(tmp_path / 'led')
+  res = replay_coordinate(led, (('epoch', 0), ('index', 4)), SYNTH, kwargs,
+                          boundary='collate')
+  assert res['match'] is True
+  for boundary in ('serve.tx', 'serve.rx'):
+    res = replay_coordinate(led, (('epoch', 0), ('gi', 2)), SYNTH, kwargs,
+                            boundary=boundary)
+    assert res['match'] is True, (boundary, res)
+
+  # the smoke gate replays one coordinate per boundary and passes
+  results, rc = replay_smoke(led, SYNTH, kwargs)
+  assert rc == 0
+  for boundary in ('collate', 'serve.tx', 'serve.rx'):
+    assert results[boundary]['status'] == 'ok', results
+
+
+def test_replay_across_world_size_reshard(binned_shards, tiny_vocab,
+                                          tmp_path):
+  """A world-2 run's per-rank collate keys replay byte-identical by
+  rebuilding each rank's loader — and both ranks together still cover
+  the same samples the world-1 stream recorded (the reshard identity
+  replay relies on)."""
+  for r in (0, 1):
+    kw = _bert_kwargs(binned_shards, tiny_vocab, dp_rank=r,
+                      dp_world_size=2, batch_size_per_rank=4)
+
+    def record(kw=kw):
+      from lddl_tpu.loader.bert import get_bert_pretrain_data_loader
+      for _ in get_bert_pretrain_data_loader(**kw):
+        pass
+    _with_ledger(tmp_path / f'led_{r}', r, record)
+
+    res = replay_coordinate(
+        str(tmp_path / f'led_{r}'), (('epoch', 0), ('index', 3)), BERT, kw,
+        boundary='collate', rank=r)
+    assert res['match'] is True, (r, res)
+
+  # distinct ranks draw distinct batches at the same coordinate
+  d0 = audit.lookup_records(audit.load_run(str(tmp_path / 'led_0')),
+                            (('epoch', 0), ('index', 3)), 'collate')
+  d1 = audit.lookup_records(audit.load_run(str(tmp_path / 'led_1')),
+                            (('epoch', 0), ('index', 3)), 'collate')
+  assert d0[0][1]['digest'] != d1[0][1]['digest']
+
+
+# ---------------------------------------------------------------------------
+# hermetic bundles + fault drill
+
+
+def test_bundle_roundtrip_and_corruption_rejected(tmp_path, monkeypatch):
+  from lddl_tpu.core import faults
+  kwargs = dict(batch_size=4, seq_len=16, steps=6)
+  batch = rematerialize_batch(SYNTH, kwargs, 0, 3)
+  bdir = str(tmp_path / 'bundle')
+  write_bundle(bdir, batch, {'epoch': 0, 'index': 3},
+               checkpoint={'dir': '/ck', 'step': 2})
+  manifest, got = read_bundle(bdir)
+  assert manifest['coordinate'] == {'epoch': 0, 'index': 3}
+  assert manifest['checkpoint'] == {'dir': '/ck', 'step': 2}
+  assert sorted(got) == sorted(batch)
+  for k in batch:
+    np.testing.assert_array_equal(got[k], batch[k])
+  assert fingerprint_batch(got) == manifest['digest']
+
+  # a flipped payload byte must be rejected with the exact coordinate
+  monkeypatch.setenv('LDDL_FAULTS', 'corrupt:replay.read')
+  faults.reset()
+  try:
+    with pytest.raises(ReplayMismatch) as exc:
+      read_bundle(bdir)
+  finally:
+    monkeypatch.delenv('LDDL_FAULTS')
+    faults.reset()
+  msg = str(exc.value)
+  assert 'epoch=0' in msg and 'index=3' in msg and 'corrupt' in msg
+
+  # a bundle from a future format version is refused, not misread
+  import json
+  mpath = tmp_path / 'bundle' / 'bundle.json'
+  doc = json.loads(mpath.read_text())
+  doc['version'] = 99
+  mpath.write_text(json.dumps(doc))
+  with pytest.raises(ValueError, match='version'):
+    read_bundle(bdir)
+
+
+# ---------------------------------------------------------------------------
+# audit --key lookup + perf gate wiring
+
+
+def test_audit_show_key(tmp_path, capsys):
+  kwargs = dict(batch_size=4, seq_len=16, steps=6)
+
+  def record():
+    for _ in MultiprocessLoader(dict(kwargs), num_workers=1, factory=SYNTH,
+                                transport='pickle'):
+      pass
+  _with_ledger(tmp_path / 'led', 0, record)
+  led = str(tmp_path / 'led')
+
+  assert audit.main(['show', led, '--key', 'epoch=0,index=3']) == 0
+  out = capsys.readouterr().out
+  assert '"index": 3' in out and '"digest"' in out
+  assert audit.main(['show', led, '--key', 'epoch=7,index=0']) == 1
+  assert audit.main(['show', led, '--key', 'not a key']) == 2
+
+
+def test_perf_replay_smoke_gate(tmp_path, capsys):
+  import json as _json
+  from lddl_tpu.telemetry import perf
+  kwargs = dict(batch_size=4, seq_len=16, steps=6)
+
+  def record():
+    for _ in MultiprocessLoader(dict(kwargs), num_workers=1, factory=SYNTH,
+                                transport='pickle'):
+      pass
+  _with_ledger(tmp_path / 'led', 0, record)
+  led = str(tmp_path / 'led')
+
+  assert perf.run_replay_smoke(led, kwargs_json=_json.dumps(kwargs)) == 0
+  assert 'replay-smoke' in capsys.readouterr().out
+  # a spec that rebuilds the wrong stream must fail the gate
+  wrong = dict(kwargs, seq_len=32)
+  assert perf.run_replay_smoke(led, kwargs_json=_json.dumps(wrong)) == 1
+
+
+# ---------------------------------------------------------------------------
+# step replay: the bit-for-bit acceptance criterion
+
+
+def test_step_replay_bit_for_bit_from_bundle(shards, tiny_vocab, tmp_path):
+  """Record 3 steps (ledger + per-step checkpoints), bundle the batch
+  step 3 consumed, then — on a fresh loop built with NO data path at
+  all — restore checkpoint 2, re-execute step 3 from the bundle, and
+  reproduce the recorded step-3 state fingerprint bit-for-bit."""
+  from lddl_tpu.replay.steps import replay_step_coordinate
+  ckpt, led = str(tmp_path / 'ckpt'), str(tmp_path / 'led')
+  parent = _loop(shards, tiny_vocab)
+  _with_ledger(tmp_path / 'led', 0,
+               lambda: parent.run(3, ckpt_dir=ckpt, ckpt_every=1,
+                                  log_every=0))
+
+  # step 3 consumed this rank's batch ordinal 2 -> collate key (0, 2);
+  # rematerialize it from the loader spec and prove it against the
+  # ledger before bundling (a mismatching bundle would be poison).
+  kw = _bert_kwargs(shards, tiny_vocab, base_seed=5)
+  res = replay_coordinate(led, (('epoch', 0), ('index', 2)), BERT, kw,
+                          boundary='collate')
+  assert res['match'] is True, res
+  bdir = str(tmp_path / 'bundle')
+  write_bundle(bdir, res['batch'], {'epoch': 0, 'index': 2},
+               digest=res['recorded'],
+               checkpoint={'dir': ckpt, 'step': 2})
+  _, batch = read_bundle(bdir)
+
+  # fresh loop, loader-free: only the bundle + the checkpoint remain
+  fresh = _loop(None, tiny_vocab)
+  assert fresh.loader is None
+  out = replay_step_coordinate(fresh, ckpt, 3, ledger_path=led,
+                               batches=[batch])
+  assert out['restored_step'] == 2
+  assert out['match'] is True, out
+  assert out['digest'] == out['recorded']
+  assert out['digest'] == parent.state_digest()
+
+  # the replay.step drill: an injected fault surfaces before the step
+  from lddl_tpu.core import faults
+  import os
+  os.environ['LDDL_FAULTS'] = 'raise:replay.step'
+  faults.reset()
+  try:
+    with pytest.raises(OSError, match='injected fault at replay.step'):
+      replay_step_coordinate(_loop(None, tiny_vocab), ckpt, 3,
+                             batches=[batch])
+  finally:
+    del os.environ['LDDL_FAULTS']
+    faults.reset()
+
+
+def test_step_replay_without_ledger_or_batches_is_loud(shards, tiny_vocab,
+                                                       tmp_path):
+  from lddl_tpu.replay.steps import replay_step_coordinate, replay_steps
+  ckpt = str(tmp_path / 'ckpt')
+  parent = _loop(shards, tiny_vocab)
+  parent.run(2, ckpt_dir=ckpt, ckpt_every=1, log_every=0)
+
+  with pytest.raises(FileNotFoundError, match='no checkpoint'):
+    replay_step_coordinate(_loop(None, tiny_vocab), str(tmp_path / 'nope'),
+                           2)
+  loaderless = _loop(None, tiny_vocab)
+  with pytest.raises(ValueError, match='bundled batches'):
+    replay_step_coordinate(loaderless, ckpt, 2)
+  with pytest.raises(ValueError, match='cannot cover'):
+    replay_steps(parent, 4, batches=[{}])
+
+
+def test_bisect_window_attributes_spike(shards, tiny_vocab, tmp_path):
+  """bisect restores inside the checkpoint retention window, replays the
+  step range, and names the spike step, the (epoch, index) batch that
+  fed it, and the dominant sample row."""
+  from lddl_tpu.replay.steps import bisect_window
+  ckpt = str(tmp_path / 'ckpt')
+  parent = _loop(shards, tiny_vocab)
+  parent.run(6, ckpt_dir=ckpt, ckpt_every=1, log_every=0)
+
+  fresh = _loop(shards, tiny_vocab)
+  out = bisect_window(fresh, ckpt, 4, 6, per_sample=True)
+  assert out['restored_step'] == 4
+  assert out['spike_step'] in (5, 6)
+  coord = out['batch_coordinate']
+  assert coord == {'epoch': 0, 'index': out['spike_step'] - 1}
+  assert len(out['per_sample']) == 8
+  assert 0 <= out['spike_sample'] < 8
+  with pytest.raises(ValueError, match='empty bisect window'):
+    bisect_window(fresh, ckpt, 6, 6)
