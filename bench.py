@@ -118,6 +118,20 @@ def _ledger_enabled():
     return False
 
 
+def _sentinel_stamp():
+  """Streaming-sentinel stamp: whether anomaly detection was armed
+  during the measurement and with which detectors. A BENCH line taken
+  with sentinels on carries their (small) per-step cost — see PERF.md
+  "Sentinel & flight recorder overhead"."""
+  try:
+    from lddl_tpu.telemetry.sentinel import get_sentinel
+    sent = get_sentinel()
+    return {'enabled': bool(sent.enabled),
+            'detectors': list(getattr(sent, 'detectors', ()) or ())}
+  except Exception:
+    return {'enabled': False, 'detectors': []}
+
+
 def _replay_stamp():
   """Replay-capability stamp: whether this build can rematerialize a
   recorded coordinate (lddl-replay present) and the repro-bundle format
@@ -285,6 +299,9 @@ def main():
         # bundle format version): names the replay contract the ledger
         # coordinates in this line are executable under.
         'replay': _replay_stamp(),
+        # Whether streaming anomaly sentinels (LDDL_SENTINEL) were armed
+        # during the measurement, and which detectors.
+        'sentinel': _sentinel_stamp(),
         # Attention masking regime of the training stack this build feeds:
         # 'full' (whole packed row attends to itself) vs 'block_diagonal'
         # (per-doc segment ids, cross-doc tiles skipped) — LDDL_BENCH_

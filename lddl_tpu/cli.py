@@ -41,6 +41,10 @@ fork's CodeBERT wrapper), all thin delegates:
                                     recorded batch or train step from
                                     the ledger; hermetic repro bundles;
                                     loss-spike bisection)
+  lddl_incident                  -> lddl_tpu.training.flight (flight-
+                                    recorder incidents: list/show
+                                    captured anomalies, shell replay/
+                                    bisect straight into lddl-replay)
 
 Runnable as ``python -m lddl_tpu.cli <name> [args...]`` or via the
 installed console scripts.
@@ -149,6 +153,11 @@ def lddl_replay(args=None):
   return main(args)
 
 
+def lddl_incident(args=None):
+  from .training.flight import main
+  return main(args)
+
+
 _COMMANDS = {
     'download_wikipedia': download_wikipedia,
     'download_books': download_books,
@@ -179,6 +188,8 @@ _COMMANDS = {
     'lddl-data-server': lddl_data_server,  # dash-form alias
     'lddl_replay': lddl_replay,
     'lddl-replay': lddl_replay,  # dash-form alias
+    'lddl_incident': lddl_incident,
+    'lddl-incident': lddl_incident,  # dash-form alias
 }
 
 

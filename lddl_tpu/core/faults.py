@@ -52,8 +52,15 @@ proven against), ``replay.read`` (:func:`corrupt_bytes` on a repro
 bundle's packed payload as ``lddl-replay`` loads it — proves a damaged
 bundle is rejected with the mismatch named at its exact coordinate),
 ``replay.step`` (replay step re-execution entry, before each replayed
-train step). ``inject()`` is a no-op (one env read) when
-``LDDL_FAULTS`` is unset, so production paths pay nothing measurable.
+train step), ``sentinel.trigger`` (the streaming sentinel's per-step
+observation — a raise-spec here is *caught* by the sentinel and
+converted into a forced trigger, the supported way to force-fire the
+whole incident-capture path), ``flight.dump`` (flight-recorder
+incident capture: a raise-spec kills the dump at entry and training
+continues, a corrupt-spec flips a byte of one bundle payload mid-dump
+so the replay reader provably rejects the damaged bundle). ``inject()``
+is a no-op (one env read) when ``LDDL_FAULTS`` is unset, so production
+paths pay nothing measurable.
 """
 
 import os

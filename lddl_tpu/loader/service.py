@@ -325,6 +325,10 @@ class DataServer:
     self._reserves_c = tele.counter('serve.reserves')
     self._backlog_g = tele.gauge('serve.backlog')
     self._clients_g = tele.gauge('serve.clients')
+    # Streaming sentinel (LDDL_SENTINEL): watches the producer's
+    # backlog for runaway growth; no-op singleton when the gate is off.
+    from ..telemetry.sentinel import get_sentinel
+    self._sentinel = get_sentinel()
     self.url = None
 
   # -- lifecycle
@@ -431,8 +435,19 @@ class DataServer:
             if self._stop.is_set():
               return
             self._buf[(epoch, step)] = (spec, payload)
-            self._backlog_g.set(len(self._buf))
+            backlog = len(self._buf)
+            self._backlog_g.set(backlog)
             self._lock.notify_all()
+          # Outside the lock: one trigger per excursion past the
+          # runaway threshold (the sentinel mutes refires itself).
+          trig = self._sentinel.observe_backlog(backlog)
+          if trig is not None:
+            from ..training.flight import get_flight_recorder
+            incident = get_flight_recorder().capture(trig)
+            warn_once(
+                f'sentinel: serve backlog runaway ({trig["reason"]})'
+                + (f' — incident captured to {incident}'
+                   if incident else ''))
           count = step + 1
         with self._lock:
           self._epoch_end[epoch] = count

@@ -186,6 +186,11 @@ def _train_step_body(model, tx, params, opt_state, rng, batch,
                          max_predictions=max_predictions)
 
   (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+  # Global gradient norm of the *raw* grads (pre-optimizer): one fused
+  # reduction inside the compiled step, read on the host for free once
+  # the loss scalar has already forced the device sync. This is the
+  # sentinel's grad_spike signal and the train.grad_norm gauge.
+  metrics['grad_norm'] = optax.global_norm(grads)
   updates, opt_state = tx.update(grads, opt_state, params)
   params = optax.apply_updates(params, updates)
   metrics['loss'] = loss

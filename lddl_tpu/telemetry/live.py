@@ -263,6 +263,10 @@ def goodput_meters(merged):
   out['ckpt_backlog'] = _gauge(metrics, 'train.ckpt_backlog')
 
   out['mfu'] = _gauge(metrics, 'train.mfu')
+  # Global gradient norm (parallel/train.py exports it from the jitted
+  # step): the live training-health meter the sentinel's grad_spike
+  # detector watches, surfaced here for the monitor's per-rank line.
+  out['grad_norm'] = _gauge(metrics, 'train.grad_norm')
   # Device-memory meters: the prefetcher's live-array accounting (the
   # measured form of the "steady-state HBM = 2 batches" donation claim)
   # and the allocator's own view sampled from device.memory_stats().
@@ -484,6 +488,12 @@ def live_status(window, rank=0, telemetry=None, include_metrics=True):
     # cross-rank comparison (compare_signals over every polled rank) —
     # the same payload divergence_over_comm allgathers in-run.
     status['ledger'] = ledger.signals()
+  from .sentinel import sentinel_status
+  sent = sentinel_status()
+  if sent is not None:
+    # Trigger counts + registered incident dirs for the monitor's
+    # INCIDENT panel; absent entirely when LDDL_SENTINEL is off.
+    status['sentinel'] = sent
   if include_metrics:
     status['metrics'] = lines
   return status

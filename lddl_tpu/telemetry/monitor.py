@@ -157,6 +157,11 @@ def poll_fleet(urls, timeout=5.0):
   ledgers = {r: s.get('ledger') for r, s in ranks.items() if s.get('ledger')}
   fleet['determinism'] = (compare_signals(ledgers)
                           if len(ledgers) > 1 else None)
+  # Sentinel triggers + incidents per rank (live_status only exports
+  # the key when LDDL_SENTINEL is on). None when no rank runs armed.
+  sentinels = {r: s.get('sentinel') for r, s in ranks.items()
+               if s.get('sentinel')}
+  fleet['sentinel'] = sentinels or None
   return fleet
 
 
@@ -237,6 +242,8 @@ def render_frame(fleet, clear=True):
       meters.append(f'attn-tiles-skipped {good["attn_tile_skip_fraction"]:.1%}')
     if good.get('mfu'):
       meters.append(f'mfu {good["mfu"]["mean"]:.1%}')
+    if good.get('grad_norm'):
+      meters.append(f'grad-norm {good["grad_norm"]["mean"]:.3g}')
     if good.get('device_live_batches'):
       meters.append(f'device-live {good["device_live_batches"]["mean"]:.1f}'
                     ' batches')
@@ -294,6 +301,24 @@ def render_frame(fleet, clear=True):
   elif det and det.get('status') == 'ok':
     out.append('')
     out.append('determinism: ok (replicated ledger streams agree)')
+  fired = {r: s for r, s in (fleet.get('sentinel') or {}).items()
+           if s.get('triggers') or s.get('incidents')}
+  if fired:
+    out.append('')
+    out.append('!! INCIDENT — sentinel trigger(s):')
+    for rank in sorted(fired):
+      s = fired[rank]
+      last = s.get('last') or {}
+      line = f'  rank {rank}: {s.get("triggers", 0)} trigger(s)'
+      if last:
+        line += (f' · last {last.get("detector", "?")} at step '
+                 f'{last.get("step")}')
+      out.append(line)
+      if last.get('reason'):
+        out.append(f'    {last["reason"]}')
+      for inc in (s.get('incidents') or [])[-3:]:
+        out.append(f'    incident {inc.get("dir")} — triage with: '
+                   f'lddl-incident show {inc.get("dir")}')
   return '\n'.join(out)
 
 

@@ -245,6 +245,12 @@ def attach_args(parser):
                            'with (default: the synthetic loader)')
   parser.add_argument('--replay-kwargs-json', default='{}',
                       help='JSON kwargs for --replay-factory')
+  parser.add_argument('--incidents', default=None, metavar='DIR',
+                      help='also scan this flight-recorder incident tree '
+                           '(training/flight.py): any incident manifest '
+                           'present fails --gate with its trigger and '
+                           'one-command replay printed — a run that '
+                           'tripped a sentinel must not pass CI')
   parser.add_argument('--json', action='store_true', dest='as_json',
                       help='emit the full verdict list as JSON')
   return parser
@@ -266,6 +272,33 @@ def run_audit(paths):
   print('lddl-perf: --audit takes one ledger path (self-check) or two '
         '(run, reference)', file=sys.stderr)
   return 2
+
+
+def check_incidents(root):
+  """``--incidents``: scan a flight-recorder tree and report every
+  incident manifest. Returns ``(rc, count)`` — rc 1 when any incident
+  (or unreadable manifest) exists, each printed with its trigger and
+  the one-command replay so the CI log IS the triage entry point."""
+  from lddl_tpu.training.flight import replay_command, scan_incidents
+  incidents = scan_incidents(root)
+  for inc in incidents:
+    man = inc.get('manifest')
+    if man is None:
+      print(f'lddl-perf: incident {inc["dir"]}: unreadable manifest '
+            f'({inc.get("error")})', file=sys.stderr)
+      continue
+    trig = man.get('trigger') or {}
+    print(f'lddl-perf: incident {inc["dir"]}: '
+          f'{trig.get("detector", "?")} at step {man.get("step")} — '
+          f'{trig.get("reason", "")}', file=sys.stderr)
+    cmd = replay_command(inc['dir'], man)
+    if cmd:
+      print(f'lddl-perf:   replay: {cmd}', file=sys.stderr)
+  if incidents:
+    print(f'lddl-perf: {len(incidents)} incident(s) under {root}',
+          file=sys.stderr)
+    return 1, len(incidents)
+  return 0, 0
 
 
 def run_replay_smoke(ledger_path, factory_spec=None, kwargs_json='{}'):
@@ -311,8 +344,18 @@ def main(argv=None):
     smoke_rc = run_replay_smoke(args.audit[0], args.replay_factory,
                                 args.replay_kwargs_json)
     audit_rc = audit_rc or smoke_rc
+  incident_rc, incident_count = 0, 0
+  if args.incidents:
+    incident_rc, incident_count = check_incidents(args.incidents)
   series = gather_series(args.root, args.history)
   if not series:
+    if args.incidents:
+      # The incident leg can verdict without bench history: a clean
+      # training run may predate any bench rounds, and a tripped
+      # sentinel must fail the gate either way.
+      print(f'lddl-perf: no bench history under {args.root!r}; '
+            'judging incidents only', file=sys.stderr)
+      return (incident_rc or audit_rc) if args.gate else 0
     print(f'lddl-perf: no bench history under {args.root!r} '
           '(expected BENCH_r*.json / MULTICHIP_r*.json / '
           'bench_history.jsonl)', file=sys.stderr)
@@ -326,6 +369,8 @@ def main(argv=None):
     out = {'verdicts': verdicts, 'regressions': len(regressions)}
     if args.audit:
       out['audit_exit'] = audit_rc
+    if args.incidents:
+      out['incidents'] = incident_count
     print(json.dumps(out, indent=2))
   else:
     for v in verdicts:
@@ -341,10 +386,15 @@ def main(argv=None):
             file=sys.stderr)
     if args.audit and audit_rc == 0:
       print('lddl-perf: determinism audit ok')
-  # One command, one verdict: under --gate a determinism failure is a
-  # gate failure exactly like a perf regression (perf's code wins when
-  # both fired, so CI triage starts from the regression list).
+    if args.incidents and incident_rc == 0:
+      print(f'lddl-perf: no incidents under {args.incidents}')
+  # One command, one verdict: under --gate a determinism failure or a
+  # captured incident is a gate failure exactly like a perf regression
+  # (perf's code wins when several fired, so CI triage starts from the
+  # regression list).
   rc = 1 if (args.gate and regressions) else 0
+  if args.gate and incident_rc and not rc:
+    rc = incident_rc
   if args.gate and audit_rc and not rc:
     rc = audit_rc
   return rc

@@ -34,8 +34,10 @@ Endpoints:
 
   - ``GET /snapshot`` — :func:`~.live.live_status` as JSON: windowed
     rates, the live bottleneck verdict (with its roofline sub-verdict),
-    straggler signals, goodput meters, HBM gauges, plus the cumulative
-    registry dump;
+    straggler signals, goodput meters, HBM gauges, the sentinel block
+    (trigger counts + incident dirs, present only when
+    ``LDDL_SENTINEL`` is armed — see :mod:`.sentinel`), plus the
+    cumulative registry dump;
   - ``GET /metrics``  — Prometheus text exposition of the cumulative
     registry (counters/gauges/histograms with cumulative ``le`` buckets
     derived from the power-of-two log buckets);

@@ -1,0 +1,546 @@
+"""Black-box flight recorder: bounded batch ring → hermetic incidents.
+
+An aircraft flight recorder does not log everything — it keeps a short
+ring of the signals that matter and freezes it when something goes
+wrong. This module does the same for a training run. While the loop
+runs, the recorder keeps:
+
+- the last **K host batches**, packed through the shm/wire
+  ``_pack_into`` spec (loader/service.py ``pack_batch``) — the *same
+  bytes* the determinism ledger fingerprints, so a frozen batch carries
+  its own ground truth;
+- each batch's **ledger coordinate** ``(epoch, index)`` via the
+  loaders' public ``coordinate_of_batch`` contract (ring ordinals are
+  global step numbers: one batch per rank per step);
+- a short window of **step metrics** (loss, grad norm, data wait) and
+  the latest **checkpoint ref**.
+
+The ring tees the *host* iterator before ``prefetch_to_device``
+(device arrays cannot be packed, and re-fetching them would perturb the
+run), which means :meth:`FlightRecorder.wrap_host_stream` executes on
+the prefetcher's producer thread — the ring is lock-protected.
+
+When a sentinel (telemetry/sentinel.py) fires, :meth:`capture` freezes
+everything into an incident directory::
+
+  incident-step42-loss_spike/
+    incident.json            # trigger, suspect coordinate, ring index,
+                             # recent metrics, live_status snapshot,
+                             # ledger excerpt, checkpoint ref
+    bundles/
+      ord000042-e0-i42/      # lddl-replay bundle per ring entry
+        bundle.json
+        batch.bin
+
+Each bundle is written with the payload's **pre-capture** fingerprint,
+so ``lddl-replay`` (or ``read_bundle``) later proves bit-for-bit
+identity — "training diverged at 3am" becomes one command to a
+hermetic repro. Capture can also arm PR 11's ``StepProfiler`` for the
+next N steps (``LDDL_FLIGHT_PROFILE_STEPS``) so the trace of the steps
+*after* the anomaly lands next to its cause.
+
+Capture must never take down the run it is documenting: every failure
+inside :meth:`capture` is caught and reported, not raised. The
+``flight.dump`` fault site drills the two failure classes — a
+raise-spec kills a dump at entry (training continues), a corrupt-spec
+flips a byte of one bundle payload mid-dump so the replay reader later
+*rejects* the damaged bundle instead of silently replaying it.
+
+The recorder rides the sentinel's gate: ``get_flight_recorder()``
+returns the live recorder only when ``LDDL_SENTINEL`` is on, else the
+shared no-op (whose ``wrap_host_stream`` returns the iterator
+untouched — zero overhead, zero threads, zero files).
+
+``main`` is the ``lddl-incident`` CLI: ``list``/``show`` incidents,
+``replay``/``bisect`` shell straight into ``lddl-replay``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+_ENV_DIR = 'LDDL_FLIGHT_DIR'
+_ENV_RING = 'LDDL_FLIGHT_RING'
+_ENV_PROFILE = 'LDDL_FLIGHT_PROFILE_STEPS'
+
+#: Incident manifest filename — what ``scan_incidents`` looks for.
+MANIFEST = 'incident.json'
+
+#: Ring capacity when ``LDDL_FLIGHT_RING`` is unset.
+DEFAULT_RING = 8
+
+#: Captures per process — a pathological run (every step fires) must
+#: not fill the disk with identical incidents.
+DEFAULT_MAX_INCIDENTS = 8
+
+
+class NoopFlightRecorder:
+  """Shared inert recorder: the stream passes through untouched."""
+
+  __slots__ = ()
+  enabled = False
+
+  def wrap_host_stream(self, it, loader=None, ordinal0=0):
+    return it
+
+  def record_step(self, step, **metrics):
+    return None
+
+  def note_checkpoint(self, ckpt_dir, step):
+    return None
+
+  def capture(self, trigger, extra=None):
+    return None
+
+
+NOOP_FLIGHT = NoopFlightRecorder()
+
+
+class FlightRecorder:
+  """Bounded ring of packed batches + step metrics, frozen on trigger."""
+
+  enabled = True
+
+  def __init__(self, out_dir=None, capacity=None, metrics_window=None,
+               profile_steps=None, max_incidents=DEFAULT_MAX_INCIDENTS):
+    if out_dir is None:
+      out_dir = os.environ.get(_ENV_DIR, '').strip()
+    if not out_dir:
+      base = os.environ.get('LDDL_TELEMETRY_DIR', '').strip() or '.'
+      out_dir = os.path.join(base, 'incidents')
+    self.out_dir = out_dir
+    raw = os.environ.get(_ENV_RING, '').strip()
+    self.capacity = int(capacity if capacity is not None
+                        else (raw or DEFAULT_RING))
+    self.metrics_window = int(metrics_window or 4 * self.capacity)
+    raw = os.environ.get(_ENV_PROFILE, '').strip()
+    self.profile_steps = int(profile_steps if profile_steps is not None
+                             else (raw or 0))
+    self.max_incidents = int(max_incidents)
+    self._ring = []      # [{'ordinal','epoch','index','spec','payload'}]
+    self._metrics = []   # [{'step', **scalars}]
+    self._checkpoint = None
+    self._lock = threading.Lock()
+    self._warned = False
+    self.incident_dirs = []
+
+  # -- recording (hot path)
+
+  def wrap_host_stream(self, it, loader=None, ordinal0=0):
+    """Tee the host batch iterator into the ring.
+
+    Runs on whatever thread drives ``it`` (the prefetcher's producer).
+    ``ordinal0`` is the global step the first yielded batch will feed;
+    coordinates come from ``loader.coordinate_of_batch`` when the
+    loader publishes that contract, else ``(None, ordinal)``. A packing
+    failure is reported once and skipped — the recorder must never
+    starve the input pipeline.
+    """
+    def tee():
+      ordinal = int(ordinal0)
+      for batch in it:
+        try:
+          self._record_batch(batch, loader, ordinal)
+        except Exception as exc:
+          if not self._warned:
+            self._warned = True
+            print(f'flight: batch recording disabled after error: '
+                  f'{type(exc).__name__}: {exc}', file=sys.stderr)
+        ordinal += 1
+        yield batch
+    return tee()
+
+  def _record_batch(self, batch, loader, ordinal):
+    from ..loader.service import pack_batch
+    spec, payload = pack_batch(batch)  # copies: detached from the batch
+    epoch = index = None
+    co = getattr(loader, 'coordinate_of_batch', None)
+    if co is not None:
+      try:
+        epoch, index = co(ordinal)
+      except Exception:
+        epoch, index = None, None
+    if index is None:
+      index = ordinal
+    with self._lock:
+      self._ring.append({'ordinal': ordinal, 'epoch': epoch,
+                         'index': index, 'spec': spec, 'payload': payload})
+      del self._ring[:-self.capacity]
+
+  def record_step(self, step, **scalars):
+    """Append one step's scalars (loss, grad_norm, data_wait) to the
+    bounded metrics window that ships inside the manifest."""
+    entry = {'step': int(step)}
+    entry.update({k: v for k, v in scalars.items() if v is not None})
+    with self._lock:
+      self._metrics.append(entry)
+      del self._metrics[:-self.metrics_window]
+
+  def note_checkpoint(self, ckpt_dir, step):
+    """Remember the newest checkpoint a replay can restore from."""
+    with self._lock:
+      self._checkpoint = {'dir': os.path.abspath(ckpt_dir),
+                          'step': int(step)}
+
+  # -- capture (cold path)
+
+  def capture(self, trigger, extra=None):
+    """Freeze the ring into an incident directory; returns its path or
+    None. Never raises: an incident dump failing must not crash the
+    training run it is documenting (the failure is reported instead)."""
+    try:
+      if len(self.incident_dirs) >= self.max_incidents:
+        if not self._warned:
+          self._warned = True
+          print(f'flight: incident cap ({self.max_incidents}) reached; '
+                'further triggers are counted but not captured',
+                file=sys.stderr)
+        return None
+      from ..core import faults
+      faults.inject('flight.dump', step=trigger.get('step'))
+      return self._dump(trigger, extra)
+    except Exception as exc:
+      print(f'flight: incident capture failed: '
+            f'{type(exc).__name__}: {exc}', file=sys.stderr)
+      return None
+
+  def _incident_dir(self, trigger):
+    step = trigger.get('step')
+    tag = (f'step{step}' if step is not None else 'async')
+    base = os.path.join(self.out_dir,
+                        f'incident-{tag}-{trigger.get("detector", "x")}')
+    path, n = base, 1
+    while os.path.exists(path):
+      n += 1
+      path = f'{base}-{n}'
+    return path
+
+  def _dump(self, trigger, extra):
+    from ..core import faults
+    from ..loader.service import unpack_batch
+    from ..replay.bundle import write_bundle
+    from ..telemetry.ledger import get_ledger
+    from ..telemetry.ledger import fingerprint_packed
+    with self._lock:
+      entries = list(self._ring)
+      metrics = list(self._metrics)
+      checkpoint = dict(self._checkpoint) if self._checkpoint else None
+    out = self._incident_dir(trigger)
+    os.makedirs(out, exist_ok=True)
+    step = trigger.get('step')
+    ring, suspect = [], None
+    for e in entries:
+      coord = ({'epoch': e['epoch'], 'index': e['index']}
+               if e['epoch'] is not None else {'index': e['index']})
+      # The recorded fingerprint is taken from the *pristine* ring
+      # bytes — the same bytes the ledger hashed at collate time. The
+      # corrupt-spec drill below damages only the dumped copy, so the
+      # replay reader proves it rejects a corrupted incident bundle.
+      digest = fingerprint_packed(e['spec'], e['payload'])
+      payload = bytearray(e['payload'])
+      faults.corrupt_bytes('flight.dump', payload, **coord)
+      name = f'ord{e["ordinal"]:06d}-e{e["epoch"]}-i{e["index"]}'
+      bdir = os.path.join(out, 'bundles', name)
+      write_bundle(bdir, unpack_batch(e['spec'], payload), coord,
+                   digest=digest, checkpoint=checkpoint)
+      entry = {'ordinal': e['ordinal'], 'coordinate': coord,
+               'digest': digest, 'payload_bytes': len(e['payload']),
+               'bundle': os.path.join('bundles', name),
+               'suspect': step is not None and e['ordinal'] == step}
+      if entry['suspect']:
+        suspect = entry
+      ring.append(entry)
+    if suspect is None and ring:
+      suspect = ring[-1]  # async triggers: newest batch is the best lead
+    led = get_ledger()
+    manifest = {
+        'version': 1,
+        'trigger': dict(trigger),
+        'step': step,
+        'replay_step': (step + 1) if step is not None else None,
+        'unix_time': time.time(),
+        'pid': os.getpid(),
+        'checkpoint': checkpoint,
+        'ring': ring,
+        'suspect': suspect,
+        'metrics': metrics,
+        'ledger': led.signals() if led.enabled else None,
+        'live_status': self._live_status(),
+        'extra': extra,
+    }
+    if self.profile_steps > 0:
+      manifest['profile'] = self._arm_profiler()
+    with open(os.path.join(out, MANIFEST), 'w') as f:
+      json.dump(manifest, f, indent=2, default=str)
+      f.write('\n')
+    self.incident_dirs.append(out)
+    from ..telemetry.sentinel import get_sentinel
+    get_sentinel().note_incident(out, trigger)
+    return out
+
+  def _live_status(self):
+    """The monitor's window snapshot when one is serving, else a fresh
+    one-sample window — best effort, None on any failure."""
+    try:
+      from ..telemetry.live import SnapshotWindow, live_status
+      from ..telemetry.server import get_monitor
+      mon = get_monitor()
+      if mon.enabled and getattr(mon, 'window', None) is not None:
+        with mon.window_lock:
+          return live_status(mon.window, rank=mon.rank,
+                             include_metrics=False)
+      return live_status(SnapshotWindow(), include_metrics=False)
+    except Exception:
+      return None
+
+  def _arm_profiler(self):
+    try:
+      from ..telemetry.profiling import get_step_profiler
+      get_step_profiler().arm(self.profile_steps)
+      return {'armed_steps': self.profile_steps}
+    except Exception as exc:
+      return {'armed_steps': 0,
+              'error': f'{type(exc).__name__}: {exc}'}
+
+
+# -- module gate: the recorder rides the sentinel's LDDL_SENTINEL gate
+
+_active = None
+
+
+def get_flight_recorder():
+  """The process flight recorder — live iff the sentinel is live."""
+  global _active
+  if _active is None:
+    from ..telemetry.sentinel import get_sentinel
+    _active = FlightRecorder() if get_sentinel().enabled else NOOP_FLIGHT
+  return _active
+
+
+def enable_flight(**kwargs):
+  """Force-enable (tests): installs and returns a fresh recorder."""
+  global _active
+  _active = FlightRecorder(**kwargs)
+  return _active
+
+
+def disable_flight():
+  """Force-disable and drop the active instance (tests)."""
+  global _active
+  _active = NOOP_FLIGHT
+
+
+# -- incident inventory (shared by lddl-incident and lddl-perf)
+
+def scan_incidents(root):
+  """Every incident under ``root`` (itself an incident dir, or a tree
+  of them), sorted by path: ``[{'dir', 'manifest'}, ...]``. Unreadable
+  manifests surface as ``{'dir', 'error'}`` — a half-written incident
+  still fails a gate."""
+  out = []
+  root = str(root)
+  if not os.path.isdir(root):
+    return out
+  # lddl: noqa[LDA001] aggregate is sorted before return below
+  for dirpath, dirnames, filenames in os.walk(root):
+    if MANIFEST not in filenames:
+      continue
+    dirnames[:] = []  # bundles inside an incident are not incidents
+    path = os.path.join(dirpath, MANIFEST)
+    try:
+      with open(path) as f:
+        out.append({'dir': dirpath, 'manifest': json.load(f)})
+    except (OSError, ValueError) as exc:
+      out.append({'dir': dirpath, 'manifest': None,
+                  'error': f'{type(exc).__name__}: {exc}'})
+  return sorted(out, key=lambda i: i['dir'])
+
+
+def replay_command(incident_dir, manifest):
+  """The one-command repro for an incident, as a shell string (what
+  ``lddl-perf --gate --incidents`` prints under a failing gate)."""
+  if not manifest:
+    return None
+  suspect = manifest.get('suspect') or {}
+  bundle = suspect.get('bundle')
+  if not bundle:
+    return None
+  bundle = os.path.join(os.path.abspath(incident_dir), bundle)
+  ckpt = manifest.get('checkpoint') or {}
+  replay_step = manifest.get('replay_step')
+  if ckpt.get('dir') and replay_step is not None:
+    return (f'lddl-replay step --bundle {bundle} '
+            f'--checkpoint-dir {ckpt["dir"]} --step {replay_step}')
+  return f'lddl-incident replay {os.path.abspath(incident_dir)}'
+
+
+# -- lddl-incident CLI
+
+def _load_manifest(incident_dir):
+  path = os.path.join(incident_dir, MANIFEST)
+  if not os.path.isfile(path):
+    raise FileNotFoundError(f'not an incident dir (no {MANIFEST}): '
+                            f'{incident_dir}')
+  with open(path) as f:
+    return json.load(f)
+
+
+def _default_root():
+  root = os.environ.get(_ENV_DIR, '').strip()
+  if root:
+    return root
+  base = os.environ.get('LDDL_TELEMETRY_DIR', '').strip() or '.'
+  return os.path.join(base, 'incidents')
+
+
+def _cmd_list(args):
+  incidents = scan_incidents(args.root)
+  if not incidents:
+    print(f'no incidents under {args.root}')
+    return 0
+  for inc in incidents:
+    man = inc['manifest']
+    if man is None:
+      print(f'{inc["dir"]}  [unreadable: {inc.get("error")}]')
+      continue
+    trig = man.get('trigger') or {}
+    print(f'{inc["dir"]}  detector={trig.get("detector", "?")} '
+          f'step={man.get("step")} '
+          f'bundles={len(man.get("ring") or [])}')
+  return 0
+
+
+def _cmd_show(args):
+  try:
+    man = _load_manifest(args.incident)
+  except (OSError, ValueError) as exc:
+    print(f'lddl-incident: {exc}', file=sys.stderr)
+    return 2
+  trig = man.get('trigger') or {}
+  print(f'incident:   {args.incident}')
+  print(f'detector:   {trig.get("detector")}')
+  print(f'step:       {man.get("step")}')
+  print(f'reason:     {trig.get("reason")}')
+  if trig.get('stats'):
+    print(f'stats:      {json.dumps(trig["stats"], default=str)}')
+  ckpt = man.get('checkpoint') or {}
+  if ckpt:
+    print(f'checkpoint: {ckpt.get("dir")} @ step {ckpt.get("step")}')
+  print('ring:')
+  for entry in man.get('ring') or []:
+    mark = ' <- suspect' if entry.get('suspect') else ''
+    print(f'  ord {entry["ordinal"]:>6}  '
+          f'({_fmt_coord(entry.get("coordinate"))})  '
+          f'{entry.get("digest")}  {entry.get("bundle")}{mark}')
+  if man.get('metrics'):
+    last = man['metrics'][-1]
+    print(f'last step metrics: {json.dumps(last, default=str)}')
+  cmd = replay_command(args.incident, man)
+  if cmd:
+    print(f'replay:     {cmd}')
+  return 0
+
+
+def _fmt_coord(coord):
+  from ..replay.rematerialize import format_coordinate
+  return format_coordinate(coord or {})
+
+
+def _suspect_bundle(incident_dir, man):
+  suspect = (man.get('suspect') or {}).get('bundle')
+  if not suspect:
+    raise LookupError('incident has no suspect bundle (empty ring?)')
+  return os.path.join(os.path.abspath(incident_dir), suspect)
+
+
+def _cmd_replay(args):
+  try:
+    man = _load_manifest(args.incident)
+    bundle = _suspect_bundle(args.incident, man)
+  except (OSError, ValueError, LookupError) as exc:
+    print(f'lddl-incident: {exc}', file=sys.stderr)
+    return 2
+  rest = [a for a in (args.rest or []) if a != '--']
+  if rest:
+    # Shell straight into lddl-replay: the bundle names the batch, the
+    # caller supplies the model/checkpoint args (e.g. --checkpoint-dir
+    # ... --step N --vocab-size V for a full step replay).
+    from ..replay.cli import main as replay_main
+    return replay_main(['step', '--bundle', bundle] + rest)
+  # No passthrough: verify the bundle's payload against its recorded
+  # fingerprint — the fast "is the repro intact" check.
+  from ..replay.bundle import read_bundle
+  from ..replay.rematerialize import ReplayMismatch
+  try:
+    manifest, _ = read_bundle(bundle)
+  except ReplayMismatch as exc:
+    print(f'lddl-incident: {exc}', file=sys.stderr)
+    return 1
+  print(f'bundle ok: {_fmt_coord(manifest.get("coordinate"))} '
+        f'digest={manifest.get("digest")}')
+  cmd = replay_command(args.incident, man)
+  if cmd:
+    print(f'full step replay: {cmd}')
+  return 0
+
+
+def _cmd_bisect(args):
+  try:
+    man = _load_manifest(args.incident)
+  except (OSError, ValueError) as exc:
+    print(f'lddl-incident: {exc}', file=sys.stderr)
+    return 2
+  ckpt = man.get('checkpoint') or {}
+  replay_step = man.get('replay_step') or man.get('step')
+  if not ckpt.get('dir') or replay_step is None:
+    print('lddl-incident: incident carries no checkpoint ref; bisect '
+          'needs one (run with ckpt_every > 0)', file=sys.stderr)
+    return 2
+  lo = max(0, int(replay_step) - max(len(man.get('ring') or []), 1))
+  rest = [a for a in (args.rest or []) if a != '--']
+  from ..replay.cli import main as replay_main
+  return replay_main(['bisect', '--checkpoint-dir', ckpt['dir'],
+                      '--lo', str(lo), '--hi', str(replay_step)] + rest)
+
+
+def attach_args(parser):
+  sub = parser.add_subparsers(dest='cmd', required=True)
+  p = sub.add_parser('list', help='inventory incidents under a root')
+  p.add_argument('--root', default=_default_root(),
+                 help='incident tree (default: LDDL_FLIGHT_DIR or '
+                      '$LDDL_TELEMETRY_DIR/incidents)')
+  p.set_defaults(fn=_cmd_list)
+  p = sub.add_parser('show', help='render one incident manifest')
+  p.add_argument('incident', help='incident directory')
+  p.set_defaults(fn=_cmd_show)
+  p = sub.add_parser(
+      'replay', help='verify the suspect bundle, or pass extra args '
+                     'through to `lddl-replay step --bundle ...`')
+  p.add_argument('incident', help='incident directory')
+  p.add_argument('rest', nargs=argparse.REMAINDER,
+                 help='forwarded to lddl-replay step')
+  p.set_defaults(fn=_cmd_replay)
+  p = sub.add_parser(
+      'bisect', help='shell into `lddl-replay bisect` over the steps '
+                     'the ring covers (loader/model args pass through)')
+  p.add_argument('incident', help='incident directory')
+  p.add_argument('rest', nargs=argparse.REMAINDER,
+                 help='forwarded to lddl-replay bisect')
+  p.set_defaults(fn=_cmd_bisect)
+  return parser
+
+
+def main(argv=None):
+  parser = argparse.ArgumentParser(
+      prog='lddl-incident',
+      description='List, inspect, and replay flight-recorder incidents.')
+  attach_args(parser)
+  args = parser.parse_args(argv)
+  return args.fn(args)
+
+
+if __name__ == '__main__':
+  sys.exit(main())

@@ -24,6 +24,7 @@ import dataclasses
 import functools
 import json
 import logging
+import math
 import os
 import time
 
@@ -496,16 +497,27 @@ class TrainLoop:
     from ..loader.device import prefetch_to_device
     from ..telemetry import get_telemetry
     from ..telemetry.profiling import get_step_profiler
+    from ..telemetry.sentinel import get_sentinel
     from ..telemetry.server import maybe_start_monitor
     from ..telemetry.trace import get_tracer
     from .elastic import (AsyncCheckpointWriter, PreemptionGuard,
                           async_ckpt_enabled)
+    from .flight import get_flight_recorder
 
     # Live metrics endpoint (LDDL_MONITOR): no-op singleton when unset.
     maybe_start_monitor(rank=max(jax.process_index(), 0))
     # GET /profile?steps=N arms this; unarmed on_step() is two attribute
     # reads, so the hook costs nothing on unwatched runs.
     profiler = get_step_profiler()
+    # Streaming anomaly sentinels + black-box recorder (LDDL_SENTINEL):
+    # both resolve to shared no-op singletons when the gate is off.
+    sentinel = get_sentinel()
+    flight = get_flight_recorder()
+    # A non-finite loss stops the run *regardless* of the sentinel gate
+    # — training on garbage is never the right default. LDDL_NONFINITE=
+    # ignore restores the old behavior (e.g. for loss-scaling probes).
+    nonfinite_stop = (os.environ.get('LDDL_NONFINITE', '')
+                      .strip().lower() != 'ignore')
     global_batch = self.loader.batch_size * max(self.dp_world, 1)
     tele = get_telemetry()
     tracer = get_tracer()
@@ -514,6 +526,7 @@ class TrainLoop:
     step_h = tele.histogram('train.step_seconds')
     steps_c = tele.counter('train.steps')
     samples_c = tele.counter('train.samples')
+    grad_norm_g = tele.gauge('train.grad_norm')
     tiles_total_c = tele.counter('train.attn_tiles_total')
     tiles_skipped_c = tele.counter('train.attn_tiles_skipped')
     peak_total = _peak_flops_total() if tele.enabled else None
@@ -532,8 +545,13 @@ class TrainLoop:
     losses = []
     try:
       while self.step < max_steps and self.stop_reason is None:
-        stream = prefetch_to_device(iter(self.loader), mesh=self.mesh,
-                                    size=prefetch)
+        # The flight recorder tees the *host* iterator (device arrays
+        # can't be packed); ordinal0 = the global step the next batch
+        # feeds, so ring entries carry their ledger collate coordinate.
+        stream = prefetch_to_device(
+            flight.wrap_host_stream(iter(self.loader), self.loader,
+                                    ordinal0=self.step),
+            mesh=self.mesh, size=prefetch)
         t0 = time.perf_counter()
         steps_this_epoch = 0
         while True:
@@ -562,10 +580,33 @@ class TrainLoop:
           # float() blocks until the device finishes the step, so the
           # compute span covers real execution, not just dispatch.
           loss = float(metrics['loss'])
+          # The loss read above already paid the device sync; this one
+          # is a host copy of an already-materialized scalar.
+          gn = metrics.get('grad_norm')
+          grad_norm = float(gn) if gn is not None else None
           losses.append(loss)
           self._last_loss = loss
           self.step += 1
           self.samples_seen += global_batch
+          if not math.isfinite(loss) and nonfinite_stop:
+            # Stop at the step boundary behind the trailing emergency
+            # checkpoint (the preemption stop path) instead of training
+            # on garbage. LDDL_NONFINITE=ignore opts out.
+            self.stop_reason = 'nonfinite_loss'
+          data_wait = t_step - t_wait
+          trigger = sentinel.observe_step(step_no, loss=loss,
+                                          grad_norm=grad_norm,
+                                          data_wait=data_wait)
+          flight.record_step(step_no, loss=loss, grad_norm=grad_norm,
+                             data_wait=data_wait)
+          if trigger is not None:
+            incident = flight.capture(trigger)
+            if incident:
+              print(f'sentinel: {trigger["detector"]} fired at step '
+                    f'{step_no} — incident captured to {incident}')
+            else:
+              print(f'sentinel: {trigger["detector"]} fired at step '
+                    f'{step_no} ({trigger["reason"]})')
           finished_trace = profiler.on_step()
           if finished_trace:
             print(f'profiler: wrote trace for step {self.step} window to '
@@ -583,6 +624,8 @@ class TrainLoop:
             step_h.observe(now - t_wait)
             steps_c.add(1)
             samples_c.add(self.loader.batch_size)
+            if grad_norm is not None:
+              grad_norm_g.set(grad_norm)
             tele.gauge('train.samples_per_sec').set(
                 self.loader.batch_size / max(now - t_wait, 1e-9))
             if peak_total:
@@ -638,11 +681,16 @@ class TrainLoop:
                   {'steps_per_sec':
                    (self.step - w_step) / max(now_m - w_t, 1e-9)})
               rate_anchor = (self.step, now_m)
-              self.stop_reason = membership.poll()
+              # Conditional assign: a quiet poll (None) must not wipe a
+              # stop reason an earlier check set (e.g. nonfinite_loss).
+              reason = membership.poll()
+              if reason is not None:
+                self.stop_reason = reason
           if self.stop_reason is not None:
             break
           if ckpt_dir and ckpt_every and self.step % ckpt_every == 0:
             self.save(ckpt_dir, writer=writer)
+            flight.note_checkpoint(ckpt_dir, self.step)
           if self.step >= max_steps:
             break
         stream.close()
@@ -664,6 +712,7 @@ class TrainLoop:
       # save IS the emergency checkpoint — complete before the return.
       if ckpt_dir and self._last_saved != self.step:
         self.save(ckpt_dir)
+        flight.note_checkpoint(ckpt_dir, self.step)
     finally:
       guard.uninstall()
       if writer is not None:

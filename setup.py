@@ -46,6 +46,7 @@ setup(
             'lddl-audit=lddl_tpu.telemetry.audit:main',
             'lddl-data-server=lddl_tpu.loader.service:main',
             'lddl-replay=lddl_tpu.replay.cli:main',
+            'lddl-incident=lddl_tpu.training.flight:main',
         ],
     },
 )

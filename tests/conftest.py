@@ -45,9 +45,12 @@ def _reset_telemetry_registries():
   import lddl_tpu.telemetry.metrics as _tm
   import lddl_tpu.telemetry.profiling as _tp
   import lddl_tpu.telemetry.roofline as _tr
+  import lddl_tpu.telemetry.sentinel as _tsn
   import lddl_tpu.telemetry.server as _ts
   import lddl_tpu.telemetry.trace as _tt
+  import lddl_tpu.training.flight as _tf
   old = (_tm._active, _tt._active, _tl._active)
+  old_sentinel = (_tsn._active, _tf._active)
   yield
   # A test that enabled the determinism ledger must not leak its open
   # append fd (or its cached resolution) into later tests.
@@ -60,6 +63,9 @@ def _reset_telemetry_registries():
   if _ts._active is not None and _ts._active.enabled:
     _ts._active.stop()
   _ts._active = None
+  # A test that enabled the sentinel/flight recorder must not leak the
+  # armed instances (or their cached gate resolution) into later tests.
+  _tsn._active, _tf._active = old_sentinel
   # Device-side caches: tests flip LDDL_PEAK_* env overrides and arm the
   # step profiler; both must re-resolve per test.
   _tr._reset_for_tests()
