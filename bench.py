@@ -10,7 +10,12 @@ masked NSP-pair Parquet shards per second per accelerator chip (the
 BASELINE.json north-star metric) at the **reference's default recipe**:
 ``duplicate_factor=5`` (five masked instances per pair, reference
 ``lddl/dask/bert/pretrain.py:377,693``). The lighter dup=1 rate is
-reported as ``dup1_mb_per_sec_per_chip`` in the same line. Both are
+reported as ``dup1_mb_per_sec_per_chip`` in the same line, the headline
+repeats as ``dup5_mb_per_sec_per_chip`` (so `lddl-perf --gate` judges
+the recipe by name), and ``shard_format`` / ``shard_formats`` stamp
+which shard format produced the headline plus a same-run
+materialized-format write-bytes comparison (README "Shard formats").
+Both rates are
 measured with the **real-scale tokenizer model**: a 30,522-entry trained
 WordPiece vocabulary (``benchmarks/assets/bench_vocab_30522.txt``, 4,754
 ``##`` continuations — see ``benchmarks/make_bench_vocab.py``) over
@@ -144,6 +149,26 @@ def _replay_stamp():
     return {'available': False, 'bundle_version': None}
 
 
+def _sink_bytes(sink):
+  """(compressed, uncompressed) bytes of the Parquet shards under ``sink``.
+
+  Compressed is the on-disk file size; uncompressed is the in-memory
+  Arrow table size — the volume the write-back path actually serializes
+  (the "dup=5 write-back wall"). lz4's 64 KB window dedupes the
+  copy-adjacent duplicated text of materialized shards almost entirely,
+  so the on-disk ratio understates the write-back work by design.
+  """
+  import pyarrow.parquet as pq
+  disk = table = 0
+  for root, _, names in os.walk(sink):
+    for n in names:
+      if '.parquet' in n:
+        p = os.path.join(root, n)
+        disk += os.path.getsize(p)
+        table += pq.read_table(p).nbytes
+  return disk, table
+
+
 def _reference_style_partition(lines, hf_tok, vocab_words, seed,
                                duplicate_factor=5):
   """The reference's per-partition hot loop, reimplemented faithfully:
@@ -247,6 +272,21 @@ def main():
     run(corpus, os.path.join(work, 'sink'), cfg, executor=executor)
     ours_s = time.perf_counter() - t0
     ours_mbps = actual_mb / ours_s / num_chips
+    dup5_bytes, dup5_table_bytes = _sink_bytes(os.path.join(work, 'sink'))
+
+    # dup=5 with the legacy materialized format, timed on the same corpus:
+    # the delta-format write-back win (bytes and rate) is evidenced inside
+    # every BENCH line instead of needing a cross-round comparison.
+    from lddl_tpu.preprocess.bert import resolve_shard_format
+    dup5_format = resolve_shard_format(cfg)
+    cfg_mat = dataclasses.replace(cfg, shard_format='materialized')
+    corpus = read_corpus([src], num_blocks=4 * executor.num_local_workers)
+    t0 = time.perf_counter()
+    run(corpus, os.path.join(work, 'sink_mat'), cfg_mat, executor=executor)
+    mat_s = time.perf_counter() - t0
+    mat_mbps = actual_mb / mat_s / num_chips
+    mat_bytes, mat_table_bytes = _sink_bytes(os.path.join(work, 'sink_mat'))
+    shutil.rmtree(os.path.join(work, 'sink_mat'), ignore_errors=True)
 
     # Reference-style hot loop (dup=5, like the timed headline run) on a
     # corpus slice, scaled.
@@ -272,6 +312,31 @@ def main():
         'unit': 'MB/s/chip',
         'vs_baseline': round(ours_mbps / ref_mbps, 3),
         'dup1_mb_per_sec_per_chip': round(dup1_mbps, 3),
+        # Explicit gated series for the dup=5 recipe (same number as
+        # 'value'; named so `lddl-perf --gate` judges it by recipe), plus
+        # the shard format that produced it.
+        'dup5_mb_per_sec_per_chip': round(ours_mbps, 3),
+        'shard_format': dup5_format,
+        # Delta-format write-back evidence: bytes and rate of the same
+        # dup=5 recipe under both formats, measured in this very run.
+        # Nested on purpose — raw byte counts must not become auto-gated
+        # history series (their direction heuristic would be wrong).
+        'shard_formats': {
+            'dup5': dup5_format,
+            'dup5_sink_bytes': dup5_bytes,
+            'dup5_materialized_sink_bytes': mat_bytes,
+            'dup5_disk_reduction':
+                round(mat_bytes / dup5_bytes, 3) if dup5_bytes else None,
+            # Uncompressed Arrow table bytes = the volume the write-back
+            # path serializes; this is the "write-back wall" number (lz4
+            # hides most of the duplicated text on disk, see _sink_bytes).
+            'dup5_table_bytes': dup5_table_bytes,
+            'dup5_materialized_table_bytes': mat_table_bytes,
+            'dup5_write_reduction':
+                round(mat_table_bytes / dup5_table_bytes, 3)
+                if dup5_table_bytes else None,
+            'dup5_materialized_mb_per_sec_per_chip': round(mat_mbps, 3),
+        },
         # The scheduler the numbers were measured under (workers, start
         # method, LPT+stealing, async write-back) — a BENCH line is not
         # comparable across scheduler configs without this.

@@ -47,6 +47,7 @@ from .core import (
     get_file_paths_for_bin_id,
 )
 from .core.utils import count_parquet_samples_strided
+from .pipeline.shard_format import scan_shard_format
 
 NUM_SAMPLES_CACHE = '.num_samples.json'
 
@@ -135,8 +136,16 @@ def balance(input_paths, output_dir, num_shards, comm, postfix=''):
 
   Returns ``{output_basename: num_samples}`` for the shards this invocation
   produced (identical on every rank).
+
+  With the mask-delta shard format each physical row is an atomic group
+  of one base pair plus its ``duplicate_factor`` per-copy deltas — the
+  contiguous-slice plan naturally never splits a group (it slices at row
+  granularity), so balanced delta shards hold ``n`` or ``n+1`` *groups*
+  (``n*dup`` or ``(n+1)*dup`` logical samples). Mixing formats would
+  break that arithmetic, so it is refused loudly up front.
   """
   paths = sorted(input_paths)
+  scan_shard_format(paths)
   files = count_samples(paths, comm)
   total = sum(f.num_samples for f in files)
   if total == 0 and comm.rank == 0:
@@ -167,6 +176,9 @@ def balance_directory(input_dir, output_dir, num_shards, comm=None):
   paths = get_all_parquets_under(input_dir)
   if not paths:
     raise ValueError(f'no parquet shards under {input_dir}')
+  # One scan over the whole sink (not just per bin group): a corpus mixing
+  # materialized and delta shards across bins is just as broken.
+  scan_shard_format(paths)
   bin_ids = get_all_bin_ids(paths)
   meta = {}
   if bin_ids:

@@ -19,6 +19,7 @@ import os
 import re
 
 import numpy as np
+import pyarrow as pa
 import pyarrow.parquet as pq
 
 
@@ -169,16 +170,20 @@ def serialize_u16_batch(values, offsets):
   ]
 
 
-def u16_batch_binary_parts(values, offsets):
-  """Batched, fully-vectorized form of :func:`serialize_u16_batch` that
-  returns Arrow-binary-column parts instead of a Python list of bytes:
+def npy_batch_binary_parts(values, offsets, dtype='<u2'):
+  """Batched, fully-vectorized serialization of many arrays at once,
+  returning Arrow-binary-column parts instead of a Python list of bytes:
   ``(value_offsets int64 [n+1], data uint8)`` where row ``i``'s value is
   the ``np.save``-compatible serialization of
-  ``values[offsets[i]:offsets[i+1]]``. The caller wraps these in
-  ``pa.BinaryArray.from_buffers`` — no per-row Python objects exist at
+  ``values[offsets[i]:offsets[i+1]]`` as ``dtype``. The caller wraps
+  these in ``pa.BinaryArray.from_buffers`` (see
+  :func:`binary_column_from_parts`) — no per-row Python objects exist at
   any point (the per-row list of ``serialize_u16_batch`` was a measured
   hot spot of the dup=5 preprocess path)."""
-  values = np.ascontiguousarray(values, dtype='<u2')
+  dtype = np.dtype(dtype)
+  descr = dtype.str
+  itemsize = dtype.itemsize
+  values = np.ascontiguousarray(values, dtype=dtype)
   offsets = np.asarray(offsets, dtype=np.int64)
   n = len(offsets) - 1
   if n <= 0:
@@ -190,13 +195,13 @@ def u16_batch_binary_parts(values, offsets):
     offsets = offsets - offsets[0]
   counts = np.diff(offsets)
   uniq = np.unique(counts)
-  hdr_bytes = {int(c): np.frombuffer(_npy_header('<u2', int(c)), np.uint8)
+  hdr_bytes = {int(c): np.frombuffer(_npy_header(descr, int(c)), np.uint8)
                for c in uniq}
   hdr_len = np.zeros(int(uniq.max()) + 1, dtype=np.int64)
   for c, h in hdr_bytes.items():
     hdr_len[c] = len(h)
   hl = hdr_len[counts]
-  row_bytes = hl + 2 * counts
+  row_bytes = hl + itemsize * counts
   boffs = np.zeros(n + 1, dtype=np.int64)
   np.cumsum(row_bytes, out=boffs[1:])
   data = np.empty(int(boffs[-1]), dtype=np.uint8)
@@ -208,11 +213,35 @@ def u16_batch_binary_parts(values, offsets):
   # each payload byte lands at (row's payload start) + (its offset within
   # the row's payload).
   payload = values.view(np.uint8)
-  nbytes = 2 * counts
-  target = (np.repeat(boffs[:n] + hl - 2 * offsets[:n], nbytes)
+  nbytes = itemsize * counts
+  target = (np.repeat(boffs[:n] + hl - itemsize * offsets[:n], nbytes)
             + np.arange(len(payload), dtype=np.int64))
   data[target] = payload
   return boffs, data
+
+
+def u16_batch_binary_parts(values, offsets):
+  """Batched, fully-vectorized form of :func:`serialize_u16_batch`
+  (uint16 positions, the ``masked_lm_positions`` column); see
+  :func:`npy_batch_binary_parts` for the general-dtype form."""
+  return npy_batch_binary_parts(values, offsets, '<u2')
+
+
+def binary_column_from_parts(boffs, bdata, n, column_name):
+  """Wrap :func:`npy_batch_binary_parts` output in an Arrow binary array,
+  guarding the int32 value-offset limit.
+
+  Arrow's plain ``binary`` type indexes values with int32 offsets, so a
+  single column is capped at 2 GiB of value bytes; a partition whose
+  serialized column exceeds that must be split upstream rather than
+  silently truncated by an offset overflow."""
+  if int(boffs[-1]) > np.iinfo(np.int32).max:
+    raise ValueError(
+        f'{column_name} column exceeds 2 GiB (Arrow int32 offset limit); '
+        'split the partition into smaller batches')
+  return pa.BinaryArray.from_buffers(
+      pa.binary(), n,
+      [None, pa.py_buffer(boffs.astype(np.int32)), pa.py_buffer(bdata)])
 
 
 _NPY_1D_HEADER_RE = re.compile(

@@ -163,7 +163,40 @@ class BertCollate:
                         np.int32, count=n)
 
     labels = np.full((n, seq_len), IGNORE_INDEX, dtype=np.int32)
-    if self._masking == 'static':
+    if self._masking == 'static' and n and 'mask_delta_positions' in rows[0]:
+      # Mask-delta shards: the A/B strings above are the UNMASKED base
+      # pair; this sample's mask is stored as a packed per-copy delta.
+      # Slice the copy's segment (mask_delta_copy comes from the
+      # dataset's row expansion) and scatter new ids into input_ids and
+      # the pre-mask originals into labels. The format stores no label
+      # column at all: the label at a masked position IS the original
+      # token, and input_ids holds exactly those original ids until the
+      # delta is applied. Byte-identical to collating the materialized
+      # form of the same corpus: token->id conversion is a bijection
+      # over the vocab (the materialized path already relies on it),
+      # masking never changes token counts, and the scatter targets are
+      # exactly the positions the writer's kernel masked.
+      from ..core.utils import deserialize_np_array
+      pos_list, new_list = [], []
+      for row in rows:
+        ks = deserialize_np_array(row['mask_delta_k']).astype(np.int64)
+        c = row['mask_delta_copy']
+        if not 0 <= c < ks.shape[0]:
+          raise AssertionError(
+              f'mask_delta_copy {c} out of range for a row with '
+              f'{ks.shape[0]} stored mask copies — corrupt delta shard or '
+              'rows not expanded by ParquetShardDataset')
+        s = int(ks[:c].sum())
+        e = s + int(ks[c])
+        pos_list.append(
+            deserialize_np_array(row['mask_delta_positions'])[s:e])
+        new_list.append(deserialize_np_array(row['mask_delta_new_ids'])[s:e])
+      counts = np.fromiter((a.shape[0] for a in pos_list), np.int64, count=n)
+      rr = np.repeat(arange_n, counts)
+      cc = np.concatenate(pos_list).astype(np.int64)
+      labels[rr, cc] = input_ids[rr, cc]
+      input_ids[rr, cc] = np.concatenate(new_list).astype(np.int32)
+    elif self._masking == 'static':
       from ..core.utils import deserialize_np_array
       pos_arrays = [
           deserialize_np_array(row['masked_lm_positions']) for row in rows

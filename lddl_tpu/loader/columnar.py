@@ -147,10 +147,76 @@ class RowView:
     return (dict, (self.to_dict(),))
 
 
+#: Synthetic field a :class:`DeltaRowView` exposes alongside its block's
+#: physical columns: which of the row's ``duplicate_factor`` mask-delta
+#: copies this logical sample is.
+COPY_FIELD = 'mask_delta_copy'
+
+
+class DeltaRowView(RowView):
+  """A logical sample of a mask-delta shard: ``(block, row, copy)``.
+
+  Delta-format shards store one physical row per base pair plus
+  ``duplicate_factor`` packed per-copy deltas; the dataset expands each
+  physical row into ``duplicate_factor`` of these handles. Field access
+  is exactly :class:`RowView` plus the synthetic ``mask_delta_copy``
+  field, which the collate uses to slice this copy's segment out of the
+  packed delta columns.
+  """
+
+  __slots__ = ('copy',)
+
+  def __init__(self, block, idx, copy):
+    super().__init__(block, idx)
+    self.copy = copy
+
+  def __getitem__(self, name):
+    if name == COPY_FIELD:
+      return self.copy
+    return super().__getitem__(name)
+
+  def get(self, name, default=None):
+    if name == COPY_FIELD:
+      return self.copy
+    return super().get(name, default)
+
+  def keys(self):
+    return list(self.block.names) + [COPY_FIELD]
+
+  def __contains__(self, name):
+    return name == COPY_FIELD or super().__contains__(name)
+
+  def __iter__(self):
+    return iter(self.keys())
+
+  def __len__(self):
+    return len(self.block.names) + 1
+
+  def items(self):
+    return [(n, self[n]) for n in self.keys()]
+
+  def values(self):
+    return [self[n] for n in self.keys()]
+
+  def to_dict(self):
+    return {n: self[n] for n in self.keys()}
+
+  def __eq__(self, other):
+    if isinstance(other, DeltaRowView):
+      return (self.block is other.block and self.idx == other.idx and
+              self.copy == other.copy)
+    if isinstance(other, dict):
+      return self.to_dict() == other
+    return NotImplemented
+
+  def __repr__(self):
+    return f'DeltaRowView({self.to_dict()!r})'
+
+
 def materialize_rows(rows):
   """Plain dicts for raw-samples consumers (no-op on dict rows): the
   ``return_raw_samples`` debug contract is ordinary dicts, not handles."""
-  return [r.to_dict() if type(r) is RowView else r for r in rows]
+  return [r.to_dict() if isinstance(r, RowView) else r for r in rows]
 
 
 def gather_token_counts(rows, name):
@@ -158,7 +224,7 @@ def gather_token_counts(rows, name):
   the block-level Arrow kernel; ``None`` when any row is not a
   :class:`RowView` (caller falls back to per-row string ops)."""
   n = len(rows)
-  if not all(type(r) is RowView for r in rows):
+  if not all(isinstance(r, RowView) for r in rows):
     return None
   return np.fromiter((r.block.token_counts(name)[r.idx] for r in rows),
                      np.int64, count=n)
@@ -168,7 +234,7 @@ def gather_numeric(rows, name, dtype):
   """Per-row values of a fixed-width column as ``dtype``, via the cached
   block-level numpy conversion; ``None`` on non-RowView rows."""
   n = len(rows)
-  if not all(type(r) is RowView for r in rows):
+  if not all(isinstance(r, RowView) for r in rows):
     return None
   return np.fromiter((r.block.npcol(name)[r.idx] for r in rows),
                      dtype, count=n)

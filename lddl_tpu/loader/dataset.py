@@ -36,9 +36,10 @@ from ..balance import load_num_samples_cache
 from ..core.log import warn_once
 from ..core.random import rng_from_key
 from ..core.utils import count_parquet_samples_strided
+from ..pipeline.shard_format import DELTA, scan_shard_format
 from ..telemetry import get_telemetry
 from ..telemetry.trace import get_tracer
-from .columnar import ColumnarBlock, RowView
+from .columnar import ColumnarBlock, DeltaRowView, RowView
 from .shuffle_buffer import ShuffleBuffer
 
 
@@ -88,6 +89,13 @@ class ParquetShardDataset:
     self._base_seed = base_seed
     self._log = logger
 
+    # Shard format: a mask-delta corpus expands each physical row into
+    # ``duplicate_factor`` logical samples (one per stored mask-delta
+    # copy). The scan also refuses mixed materialized/delta file sets
+    # loudly — their sample arithmetic is incompatible.
+    self._shard_format, dup = scan_shard_format(self._files)
+    self._expansion = dup if self._shard_format == DELTA else 1
+
     counts = count_samples(self._files, comm=comm)
     values = list(counts.values())
     lo, hi = min(values), max(values)
@@ -101,7 +109,10 @@ class ParquetShardDataset:
           f'{dp_world_size}')
     # Truncate every file to the min count so each rank sees exactly the
     # same number of samples (reference torch/datasets.py:150-156).
-    self._samples_per_file = lo
+    # Counts (and truncation) are physical rows; a truncated delta row
+    # drops its whole group of copies, so expansion stays atomic.
+    self._rows_per_file = lo
+    self._samples_per_file = lo * self._expansion
     lost = sum(values) - lo * len(self._files)
     if lost > 0:
       msg = (f'truncating shards to {lo} samples each: {lost} samples lost '
@@ -115,7 +126,17 @@ class ParquetShardDataset:
     return len(self._files)
 
   @property
+  def shard_format(self):
+    return self._shard_format
+
+  @property
+  def duplicate_factor(self):
+    """Logical samples per physical row (1 for materialized shards)."""
+    return self._expansion
+
+  @property
   def samples_per_file(self):
+    """Logical samples per file (physical rows × delta expansion)."""
     return self._samples_per_file
 
   @property
@@ -143,16 +164,22 @@ class ParquetShardDataset:
     the reference: resume replays the identical stream suffix.
     """
     files = self.rank_files_for_epoch(epoch)
-    skip_files, skip_rows = (0, 0)
+    skip_files, skip_rows, skip_copies = (0, 0, 0)
     if samples_to_skip:
       skip_files = samples_to_skip // self._samples_per_file
-      skip_rows = samples_to_skip % self._samples_per_file
+      rem = samples_to_skip % self._samples_per_file
+      # Delta shards: a physical row is duplicate_factor logical samples,
+      # so a resume point may land mid-group — skip whole rows, then the
+      # leading copies of the first emitted row.
+      skip_rows = rem // self._expansion
+      skip_copies = rem % self._expansion
     rng = rng_from_key(self._base_seed, 'shuffle', epoch, self._dp_rank)
     buf = ShuffleBuffer(self._shuffle_buffer_size,
                         self._shuffle_buffer_warmup_factor, rng)
-    return buf.shuffle_stream(self._row_stream(files, skip_files, skip_rows))
+    return buf.shuffle_stream(
+        self._row_stream(files, skip_files, skip_rows, skip_copies))
 
-  def _row_stream(self, files, skip_files, skip_rows):
+  def _row_stream(self, files, skip_files, skip_rows, skip_copies=0):
     # Telemetry handles are fetched once per stream (not per event): in
     # disabled mode they are the shared no-op singletons, so the per-row
     # cost is one empty method call.
@@ -160,11 +187,13 @@ class ParquetShardDataset:
     tracer = get_tracer()
     rows_c = tele.counter('loader.rows')
     decode_h = tele.histogram('loader.read_batch_seconds')
+    expansion = self._expansion
+    delta = self._shard_format == DELTA
     for fi, path in enumerate(files):
       if fi < skip_files:
         continue
       with pq.ParquetFile(path) as pf:
-        remaining = self._samples_per_file
+        remaining = self._rows_per_file
         to_skip = skip_rows if fi == skip_files else 0
         batches = pf.iter_batches()
         while remaining > 0:
@@ -182,6 +211,19 @@ class ParquetShardDataset:
           # access (RowView.__getitem__ / the gather_* fast paths).
           block = ColumnarBlock(batch)
           start, to_skip = to_skip, 0
-          rows_c.add(take - start)
-          for r in range(start, take):
-            yield RowView(block, r)
+          if not delta:
+            rows_c.add(take - start)
+            for r in range(start, take):
+              yield RowView(block, r)
+          else:
+            # Even dup=1 delta rows need the copy index: the collate
+            # slices the packed delta columns by `mask_delta_copy`.
+            # Delta shards: expand each physical row into its
+            # duplicate_factor logical copies, in copy order — the same
+            # order the materialized format stores them, which is what
+            # keeps the two formats' delivered streams identical.
+            rows_c.add((take - start) * expansion - skip_copies)
+            for r in range(start, take):
+              first_copy, skip_copies = skip_copies, 0
+              for c in range(first_copy, expansion):
+                yield DeltaRowView(block, r, c)

@@ -129,8 +129,11 @@ def write_samples_partition(
                       type=schema.field(field).type)
       for field in schema.names
   }
+  # Build against the caller's schema (not a re-inferred one) so schema
+  # metadata — e.g. the shard-format tag (pipeline/shard_format.py) —
+  # rides into the written file's footer.
   return write_table_partition(
-      pa.table(cols),
+      pa.table(cols, schema=schema),
       out_dir,
       partition_idx,
       bin_size=bin_size,

@@ -1,0 +1,123 @@
+"""Shard format tags: materialized vs mask-delta Parquet corpora.
+
+Two on-disk layouts share the shard/bin naming and balance contracts:
+
+  - ``materialized`` — one physical row per training sample. Masked
+    runs store the post-masking A/B token strings plus the
+    ``masked_lm_positions``/``masked_lm_labels`` columns; this is the
+    reference-compatible layout and the implicit format of every shard
+    written before the tag existed (absent metadata == materialized).
+  - ``delta`` — one physical row per *base* (unmasked) pair plus
+    ``duplicate_factor`` tiny per-copy mask deltas packed into three
+    npy-framed binary columns (``mask_delta_positions`` /
+    ``mask_delta_new_ids`` / ``mask_delta_k``). No label column at all:
+    the label at a masked position is the original token, which the
+    collate reads out of the assembled input ids before applying the
+    delta. The loader expands each physical row into
+    ``duplicate_factor`` logical samples and reconstructs the masked
+    row at collate time — byte-identical to what the materialized
+    format would have collated (tests/test_shard_format.py), at ~1/dup
+    of the write/storage/wire bytes.
+
+The tag rides in the Arrow schema metadata (which Parquet round-trips
+through its key-value metadata, and which ``Table.take`` /
+``append_column`` / ``concat_tables`` all preserve), so it survives the
+binned partition writer and the balancer unchanged.
+
+Formats must not be mixed within one corpus: a delta row expands to
+``dup`` samples while a materialized row is one sample, so a mixed file
+set has no consistent sample arithmetic. The balancer and the loader
+both refuse loudly (:func:`scan_shard_format`).
+"""
+
+import pyarrow.parquet as pq
+
+MATERIALIZED = 'materialized'
+DELTA = 'delta'
+
+FORMAT_KEY = b'lddl_shard_format'
+DUP_KEY = b'lddl_duplicate_factor'
+
+#: The three ragged-packed delta columns of a delta-format BERT shard,
+#: in schema order. Each holds npy-framed arrays (serialize_np_array
+#: wire format, same as ``masked_lm_positions``): the concatenation of
+#: the row's ``duplicate_factor`` per-copy segments for positions and
+#: post-mask new ids, plus the per-copy segment lengths ``k``.
+DELTA_COLUMNS = ('mask_delta_positions', 'mask_delta_new_ids',
+                 'mask_delta_k')
+
+
+def _tag_metadata(existing, shard_format, duplicate_factor):
+  if shard_format not in (MATERIALIZED, DELTA):
+    raise ValueError(f'unknown shard format {shard_format!r}')
+  meta = dict(existing or {})
+  meta[FORMAT_KEY] = shard_format.encode()
+  meta[DUP_KEY] = str(int(duplicate_factor)).encode()
+  return meta
+
+
+def tag_table(table, shard_format, duplicate_factor):
+  """Attach (merge) the shard-format tag into a table's schema metadata."""
+  return table.replace_schema_metadata(
+      _tag_metadata(table.schema.metadata, shard_format, duplicate_factor))
+
+
+def tag_schema(schema, shard_format, duplicate_factor):
+  """Schema-level sibling of :func:`tag_table` (for dict-path writers that
+  hand a schema to ``write_samples_partition``)."""
+  return schema.with_metadata(
+      _tag_metadata(schema.metadata, shard_format, duplicate_factor))
+
+
+def format_of_schema(schema):
+  """``(shard_format, duplicate_factor)`` from an Arrow schema.
+
+  Untagged schemas (every pre-tag shard, and the reference's own
+  output) read as ``('materialized', 1)``. The duplicate factor is only
+  meaningful for expansion under the delta format; materialized shards
+  report whatever the writer stamped (provenance) but are never
+  expanded.
+  """
+  meta = schema.metadata or {}
+  fmt = meta.get(FORMAT_KEY, b'materialized').decode()
+  if fmt not in (MATERIALIZED, DELTA):
+    raise ValueError(f'unknown shard format tag {fmt!r} in schema metadata')
+  dup = int(meta.get(DUP_KEY, b'1'))
+  if dup < 1:
+    raise ValueError(f'invalid duplicate_factor tag {dup}')
+  return fmt, dup
+
+
+def shard_format_of(path):
+  """``(shard_format, duplicate_factor)`` of one Parquet shard, from the
+  footer metadata only (no data pages are read)."""
+  return format_of_schema(pq.read_schema(path))
+
+
+def scan_shard_format(paths):
+  """The single ``(shard_format, duplicate_factor)`` all ``paths`` agree
+  on. Raises ``ValueError`` on a mixed corpus — materialized and delta
+  shards have incompatible sample arithmetic (a delta row is
+  ``duplicate_factor`` samples), so mixing them would silently corrupt
+  balance/epoch accounting. Refusing here (balancer and loader both
+  call this) is the documented contract (MIGRATING.md)."""
+  if not paths:
+    return MATERIALIZED, 1
+  seen = {}
+  for p in paths:
+    fmt, dup = shard_format_of(p)
+    # For materialized shards the stamped duplicate_factor is provenance
+    # only (every row is already one sample), so differing stamps — or a
+    # mix of tagged and legacy untagged shards — are compatible. For
+    # delta shards dup IS the expansion factor, so it must agree.
+    key = (fmt, dup if fmt == DELTA else 1)
+    seen.setdefault(key, []).append(p)
+  if len(seen) > 1:
+    desc = '; '.join(
+        f'{fmt} (dup={dup}): e.g. {ps[0]}' for (fmt, dup), ps in
+        sorted(seen.items()))
+    raise ValueError(
+        f'mixed shard formats in one corpus: {desc} — materialized and '
+        'delta shards may not be mixed (and delta shards must share one '
+        'duplicate_factor); re-preprocess with a single --shard-format')
+  return next(iter(seen))

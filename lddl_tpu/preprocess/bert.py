@@ -32,9 +32,13 @@ import pyarrow as pa
 
 from ..core import attach_bool_arg, serialize_np_array
 from ..core.random import rng_from_key
+from ..core.utils import (binary_column_from_parts, npy_batch_binary_parts,
+                          u16_batch_binary_parts)
 from ..pipeline.executor import Executor
 from ..pipeline.parquet_io import write_samples_partition, write_table_partition
 from ..pipeline.pool import current_writer
+from ..pipeline.shard_format import (DELTA, DELTA_COLUMNS, MATERIALIZED,
+                                     tag_table)
 from ..pipeline.shuffle import gather_partition
 from ..tokenization import split_sentences
 from .common import run_shuffled
@@ -298,32 +302,93 @@ def _string_column(tokenizer, flat_ids, offsets):
   return pa.array(tokenizer.decode_join(flat_ids, offsets), type=pa.string())
 
 
+def resolve_shard_format(cfg):
+  """Resolve ``cfg.shard_format`` ('auto' | 'materialized' | 'delta').
+
+  'auto' picks delta exactly where it wins: fast-engine static masking
+  with ``duplicate_factor > 1`` (the dup copies of a pair differ only by
+  their mask, so storing the base once plus per-copy deltas cuts write
+  bytes ~duplicate_factor×). Explicit 'delta' is validated loudly: an
+  unmasked run has no mask delta to store (unmasked dup copies differ by
+  their *pairing*, which delta cannot represent), and the python engine
+  materializes per-document instances with no columnar delta path.
+  """
+  fmt = cfg.shard_format
+  if fmt == 'auto':
+    if cfg.masking and cfg.duplicate_factor > 1 and cfg.engine == 'fast':
+      return DELTA
+    return MATERIALIZED
+  if fmt == DELTA:
+    if not cfg.masking:
+      raise ValueError(
+          '--shard-format delta requires --masking: unmasked duplicate '
+          'copies differ by pairing, not by a mask delta')
+    if cfg.engine != 'fast':
+      raise ValueError(
+          "--shard-format delta requires the fast engine (engine='fast')")
+  elif fmt != MATERIALIZED:
+    raise ValueError(f'unknown shard format {fmt!r}')
+  return fmt
+
+
+def _fused_string_col(parts):
+  """(offsets, utf8 data) from the native fused assembler -> Arrow column."""
+  out_offsets, data = parts
+  return pa.StringArray.from_buffers(
+      len(out_offsets) - 1, pa.py_buffer(out_offsets), pa.py_buffer(data))
+
+
 def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
   """The fast path: tokenize -> plan pairs -> batched (device) masking ->
-  Arrow table. Returns a ``pyarrow.Table`` matching :func:`bert_schema`.
+  Arrow table. Returns a ``pyarrow.Table`` matching :func:`bert_schema`
+  for the resolved shard format, tagged via
+  :func:`~lddl_tpu.pipeline.shard_format.tag_table`.
 
   This is the TPU-first redesign of the reference's per-partition hot loop
   (``lddl/dask/bert/pretrain.py:77-97,182-238``): token ids end-to-end,
   contiguous-range pair planning, one batched masking call on the
   accelerator, and zero-copy Arrow column assembly.
+
+  Masked runs with ``duplicate_factor > 1`` plan the base pairs ONCE and
+  tile the ranges copy-adjacent (p0c0, p0c1, ..., p0c{dup-1}, p1c0, ...);
+  the counter-based Philox mask stream is keyed by row index, so each
+  tiled copy draws an independent mask for free. This holds for BOTH
+  shard formats, which is what makes them logically equivalent
+  row-for-row — the delta format just stores each base once plus the
+  per-copy (positions, new_ids, label_ids, k) deltas instead of
+  materializing dup masked rows.
   """
   from ..ops import masking as _masking_ops
-  from ..core.utils import u16_batch_binary_parts
   from .pairing import plan_pairs_partition
 
   from ..ops.masking import (mask_partition_device, mask_partition_host,
                              resolve_mask_backend)
 
+  shard_format = resolve_shard_format(cfg)
+  delta = shard_format == DELTA
+
   docs = encode_documents(doc_texts, tokenizer,
                           sentence_backend=cfg.sentence_backend)
   if len(docs) == 0:
-    names = bert_schema(cfg.masking).names
-    return pa.table({n: pa.array([], type=bert_schema(cfg.masking)
-                                 .field(n).type) for n in names})
-  a_ranges, b_ranges, is_random_next = plan_pairs_partition(
+    return tag_table(
+        bert_schema(cfg.masking, shard_format).empty_table(),
+        shard_format, cfg.duplicate_factor)
+  # Masked dup>1: plan base pairs once and tile copy-adjacent (see
+  # docstring). Unmasked dup>1 keeps the legacy per-copy planning passes
+  # (one continuing rng stream), matching the python engine pass-for-pass.
+  plan_once = cfg.masking and cfg.duplicate_factor > 1
+  base_a, base_b, base_irn = plan_pairs_partition(
       docs, rng, max_seq_length=cfg.target_seq_length,
       short_seq_prob=cfg.short_seq_prob,
-      duplicate_factor=cfg.duplicate_factor)
+      duplicate_factor=1 if plan_once else cfg.duplicate_factor)
+  dup = cfg.duplicate_factor if plan_once else 1
+  nbase = len(base_a)
+  if plan_once and dup > 1:
+    a_ranges = np.repeat(base_a, dup, axis=0)
+    b_ranges = np.repeat(base_b, dup, axis=0)
+    is_random_next = np.repeat(np.asarray(base_irn), dup)
+  else:
+    a_ranges, b_ranges, is_random_next = base_a, base_b, base_irn
   flat_ids = docs.flat_ids
   n = len(a_ranges)
   na = (a_ranges[:, 1] - a_ranges[:, 0]).astype(np.int64)
@@ -341,6 +406,7 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
   offs_b = np.zeros(n + 1, dtype=np.int64)
   np.cumsum(nb, out=offs_b[1:])
 
+  newv = None
   if mask_mode == 'host':
     # Fused ragged path: one native pass gathers A/B, draws k Fisher-
     # Yates picks per row from a counter-based Philox stream, applies
@@ -351,12 +417,23 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
         flat_ids, a_ranges, b_ranges, masked_lm_ratio=cfg.masked_lm_ratio,
         vocab_size=tokenizer.vocab_size, mask_id=tokenizer.mask_token_id,
         seed=mask_seed, offs_a=offs_a, offs_b=offs_b)
+    if delta:
+      # The host kernel applies the delta in place; re-read the post-mask
+      # ids at the picked positions so the delta columns can store them.
+      ri = np.repeat(np.arange(n, dtype=np.int64), k)
+      ci64 = ci.astype(np.int64)
+      in_a = ci64 < 1 + na[ri]
+      idx_a = offs_a[ri] + ci64 - 1
+      idx_b = offs_b[ri] + ci64 - 2 - na[ri]
+      newv = np.where(in_a, flat_a[np.where(in_a, idx_a, 0)],
+                      flat_b[np.where(in_a, 0, idx_b)])
   else:
-    # Ragged gather straight from the flat partition ids (no id matrix).
-    ra, ca = _masking_ops.ragged_indices(na)
-    flat_a = flat_ids[a_ranges[ra, 0] + ca]
-    rb, cb = _masking_ops.ragged_indices(nb)
-    flat_b = flat_ids[b_ranges[rb, 0] + cb]
+    if not delta:
+      # Ragged gather straight from the flat partition ids (no id matrix).
+      ra, ca = _masking_ops.ragged_indices(na)
+      flat_a = flat_ids[a_ranges[ra, 0] + ca]
+      rb, cb = _masking_ops.ragged_indices(nb)
+      flat_b = flat_ids[b_ranges[rb, 0] + cb]
     if mask_mode == 'device':
       positions, new_ids, kk = mask_partition_device(
           flat_ids, a_ranges, b_ranges, seq_len=cfg.target_seq_length,
@@ -370,30 +447,79 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
       ri = np.nonzero(pm)[0]
       ci = positions[pm].astype(np.int64)  # sorted within each row
       in_a = ci < 1 + na[ri]
-      # Original (label) ids, read from the flat array via the ranges.
-      idx_a = a_ranges[ri, 0] + ci - 1
-      idx_b = b_ranges[ri, 0] + ci - 2 - na[ri]
-      label_ids = np.where(
-          in_a, flat_ids[np.where(in_a, idx_a, 0)],
-          flat_ids[np.where(in_a, 0, idx_b)]).astype(np.int32)
-      # Apply the post-masking ids into the ragged A/B columns.
-      newv = new_ids[pm].astype(flat_a.dtype)
-      tgt_a = offs_a[ri] + ci - 1
-      flat_a[tgt_a[in_a]] = newv[in_a]
-      tgt_b = offs_b[ri] + ci - 2 - na[ri]
-      flat_b[tgt_b[~in_a]] = newv[~in_a]
+      if not delta:
+        # Original (label) ids, read from the flat array via the ranges
+        # (the delta format stores no labels — collate recovers them).
+        idx_a = a_ranges[ri, 0] + ci - 1
+        idx_b = b_ranges[ri, 0] + ci - 2 - na[ri]
+        label_ids = np.where(
+            in_a, flat_ids[np.where(in_a, idx_a, 0)],
+            flat_ids[np.where(in_a, 0, idx_b)]).astype(np.int32)
+      newv = new_ids[pm].astype(flat_ids.dtype)
+      if not delta:
+        # Apply the post-masking ids into the ragged A/B columns.
+        tgt_a = offs_a[ri] + ci - 1
+        flat_a[tgt_a[in_a]] = newv[in_a]
+        tgt_b = offs_b[ri] + ci - 2 - na[ri]
+        flat_b[tgt_b[~in_a]] = newv[~in_a]
 
   offs_l = None
   if cfg.masking:
     offs_l = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(k, out=offs_l[1:])
 
+  from .common import fused_string_columns
+
+  if delta:
+    # Delta format: one physical row per BASE pair. The A/B strings come
+    # from the unmasked base ids; the dup per-copy mask deltas are packed
+    # ragged into four binary columns. Tiled rows are copy-adjacent, so
+    # each base row's delta span is a pure stride view: offs_l[::dup].
+    base_na = na[::dup]
+    base_nb = nb[::dup]
+    boffs_a = np.zeros(nbase + 1, dtype=np.int64)
+    np.cumsum(base_na, out=boffs_a[1:])
+    boffs_b = np.zeros(nbase + 1, dtype=np.int64)
+    np.cumsum(base_nb, out=boffs_b[1:])
+    ra, ca = _masking_ops.ragged_indices(base_na)
+    base_flat_a = flat_ids[base_a[ra, 0] + ca]
+    rb, cb = _masking_ops.ragged_indices(base_nb)
+    base_flat_b = flat_ids[base_b[rb, 0] + cb]
+    fused = fused_string_columns(
+        tokenizer, [(base_flat_a, boffs_a), (base_flat_b, boffs_b)])
+    if fused is not None:
+      string_parts, _ = fused
+      col_a = _fused_string_col(string_parts[0])
+      col_b = _fused_string_col(string_parts[1])
+    else:
+      col_a = _string_column(tokenizer, base_flat_a, boffs_a)
+      col_b = _string_column(tokenizer, base_flat_b, boffs_b)
+    cols = {
+        'A': col_a,
+        'B': col_b,
+        'is_random_next': pa.array(np.asarray(base_irn)),
+        'num_tokens': pa.array((base_na + base_nb + 3).astype(np.uint16),
+                               type=pa.uint16()),
+    }
+    doffs = offs_l[::dup]
+    koffs = np.arange(nbase + 1, dtype=np.int64) * dup
+    # No label column: the label at a masked position is the original
+    # token, which the collate reads out of input_ids before applying
+    # the delta. Post-mask ids fit u2 whenever the vocab does.
+    new_dt = '<u2' if tokenizer.vocab_size <= 1 << 16 else '<i4'
+    for name, vals, offs, dt in (
+        ('mask_delta_positions', ci, doffs, '<u2'),
+        ('mask_delta_new_ids', newv, doffs, new_dt),
+        ('mask_delta_k', k, koffs, '<u2')):
+      bo, bd = npy_batch_binary_parts(vals, offs, dt)
+      cols[name] = binary_column_from_parts(bo, bd, nbase, name)
+    return tag_table(pa.table(cols), DELTA, dup)
+
   # Fused native columnar assembly (LDDL_NATIVE_COLUMNAR, default on):
   # every string column and the npy-framed positions column in one native
   # round trip — no numpy capacity/framing passes, no buffer re-copies.
   # Bytes are identical to the per-column fallback below (tested), so the
   # shard contract f(task, global_index) is unchanged.
-  from .common import fused_string_columns
   emit_cols = [(flat_a, offs_a), (flat_b, offs_b)]
   if cfg.masking:
     emit_cols.append((label_ids, offs_l))
@@ -402,30 +528,18 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
       positions=(ci, offs_l) if cfg.masking else None)
   if fused is not None:
     string_parts, pos_parts = fused
-
-    def _col(parts):
-      out_offsets, data = parts
-      return pa.StringArray.from_buffers(
-          len(out_offsets) - 1, pa.py_buffer(out_offsets),
-          pa.py_buffer(data))
-
     cols = {
-        'A': _col(string_parts[0]),
-        'B': _col(string_parts[1]),
+        'A': _fused_string_col(string_parts[0]),
+        'B': _fused_string_col(string_parts[1]),
         'is_random_next': pa.array(is_random_next),
         'num_tokens': pa.array(row_len.astype(np.uint16), type=pa.uint16()),
     }
     if cfg.masking:
       boffs, bdata = pos_parts
-      if int(boffs[-1]) > np.iinfo(np.int32).max:
-        raise ValueError(
-            'masked_lm_positions column exceeds 2 GiB (Arrow int32 offset '
-            'limit); split the partition into smaller batches')
-      cols['masked_lm_positions'] = pa.BinaryArray.from_buffers(
-          pa.binary(), n, [None, pa.py_buffer(boffs.astype(np.int32)),
-                           pa.py_buffer(bdata)])
-      cols['masked_lm_labels'] = _col(string_parts[2])
-    return pa.table(cols)
+      cols['masked_lm_positions'] = binary_column_from_parts(
+          boffs, bdata, n, 'masked_lm_positions')
+      cols['masked_lm_labels'] = _fused_string_col(string_parts[2])
+    return tag_table(pa.table(cols), MATERIALIZED, cfg.duplicate_factor)
 
   cols = {
       'A': _string_column(tokenizer, flat_a, offs_a),
@@ -435,28 +549,24 @@ def process_partition_columnar(doc_texts, tokenizer, cfg, rng, mask_seed):
   }
   if cfg.masking:
     boffs, bdata = u16_batch_binary_parts(ci, offs_l)
-    if int(boffs[-1]) > np.iinfo(np.int32).max:
-      # Same loud failure as the string columns (decode_join_buffers):
-      # Arrow binary offsets are int32 — a silent astype wrap would
-      # write corrupt shards.
-      raise ValueError(
-          'masked_lm_positions column exceeds 2 GiB (Arrow int32 offset '
-          'limit); split the partition into smaller batches')
-    cols['masked_lm_positions'] = pa.BinaryArray.from_buffers(
-        pa.binary(), n, [None, pa.py_buffer(boffs.astype(np.int32)),
-                         pa.py_buffer(bdata)])
+    cols['masked_lm_positions'] = binary_column_from_parts(
+        boffs, bdata, n, 'masked_lm_positions')
     cols['masked_lm_labels'] = _string_column(tokenizer, label_ids, offs_l)
-  return pa.table(cols)
+  return tag_table(pa.table(cols), MATERIALIZED, cfg.duplicate_factor)
 
 
-def bert_schema(masking):
+def bert_schema(masking, shard_format=MATERIALIZED):
   fields = [
       ('A', pa.string()),
       ('B', pa.string()),
       ('is_random_next', pa.bool_()),
       ('num_tokens', pa.uint16()),
   ]
-  if masking:
+  if shard_format == DELTA:
+    if not masking:
+      raise ValueError('delta shard format requires masking')
+    fields += [(name, pa.binary()) for name in DELTA_COLUMNS]
+  elif masking:
     fields += [
         ('masked_lm_positions', pa.binary()),
         ('masked_lm_labels', pa.string()),
@@ -481,6 +591,9 @@ class BertPretrainConfig:
   bin_size: int = None
   seed: int = 12345
   output_format: str = 'parquet'
+  # 'auto' resolves to 'delta' for fast-engine masked duplicate_factor>1
+  # runs (see resolve_shard_format), 'materialized' otherwise.
+  shard_format: str = 'auto'
 
   @property
   def nbins(self):
@@ -610,11 +723,16 @@ def run(corpus, sink_dir, cfg, executor=None, num_shuffle_partitions=None):
         local = 'host'
     resolved = executor.comm.broadcast_object(local, root=0)
     cfg = dataclasses.replace(cfg, mask_backend=resolved)
+  # Resolve the shard format once up front: it is part of the output
+  # contract (and invalid combinations — delta without masking, delta on
+  # the python engine — must fail loudly before any worker starts).
+  cfg = dataclasses.replace(cfg, shard_format=resolve_shard_format(cfg))
   if executor.comm.rank == 0:
     mask = (cfg.mask_backend
             if cfg.masking and cfg.engine == 'fast' else 'off')
     print(f'preprocess backends: tokenizer={cfg.tokenizer_backend} '
-          f'sentences={cfg.sentence_backend} mask={mask}')
+          f'sentences={cfg.sentence_backend} mask={mask} '
+          f'format={cfg.shard_format}')
   return run_shuffled(
       corpus,
       sink_dir,
@@ -658,6 +776,13 @@ def attach_args(parser):
   parser.add_argument('--duplicate-factor', type=int, default=5)
   parser.add_argument('--bin-size', type=int, default=None)
   parser.add_argument('--masked-lm-ratio', type=float, default=0.15)
+  parser.add_argument('--shard-format', type=str, default='auto',
+                      choices=['auto', 'materialized', 'delta'],
+                      help='on-disk shard layout: materialized stores every '
+                      'masked duplicate row in full; delta stores each base '
+                      'pair once plus per-copy mask deltas (~duplicate_factor'
+                      'x fewer write bytes). auto: delta for fast-engine '
+                      'masked duplicate_factor>1 runs, else materialized')
   attach_bool_arg(parser, 'masking', default=False,
                   help_str='store static MLM masks')
   attach_bool_arg(parser, 'lowercase', default=True)
@@ -715,6 +840,7 @@ def main(args=None):
       bin_size=args.bin_size,
       seed=args.seed,
       output_format=args.output_format,
+      shard_format=args.shard_format,
   )
   t0 = time.perf_counter()
   with executor:

@@ -33,6 +33,8 @@ from ..core.random import rng_from_key
 from ..pipeline.executor import Executor
 from ..pipeline.parquet_io import write_samples_partition, write_table_partition
 from ..pipeline.pool import current_writer
+from ..pipeline.shard_format import (DELTA, MATERIALIZED, tag_schema,
+                                     tag_table)
 from ..pipeline.shuffle import gather_partition
 from .common import run_shuffled
 from .readers import read_code, split_id_code_docstring
@@ -266,6 +268,9 @@ class CodebertPretrainConfig:
   bin_size: int = None
   seed: int = 12345
   output_format: str = 'parquet'
+  # 'auto' resolves to 'delta' for duplicate_factor>1 (one stored pass,
+  # expanded by the loader; dynamic masking differentiates the copies).
+  shard_format: str = 'auto'
 
   @property
   def nbins(self):
@@ -274,6 +279,24 @@ class CodebertPretrainConfig:
     if self.target_seq_length % self.bin_size != 0:
       raise ValueError('bin_size must divide target_seq_length')
     return self.target_seq_length // self.bin_size
+
+
+def resolve_shard_format(cfg):
+  """'auto' -> 'delta' iff ``duplicate_factor > 1``.
+
+  CodeBERT masks dynamically at load time, so the materialized dup loop
+  only re-plans the same records with a continuing rng (slightly jittered
+  chunking per pass). The delta format stores one pass and lets the
+  loader expand each row ``duplicate_factor`` times — the copies share
+  the pairing and are differentiated by the dynamic mask draw, which is
+  what the duplicate-factor recipe is for.
+  """
+  fmt = cfg.shard_format
+  if fmt == 'auto':
+    return DELTA if cfg.duplicate_factor > 1 else MATERIALIZED
+  if fmt not in (MATERIALIZED, DELTA):
+    raise ValueError(f'unknown shard format {fmt!r}')
+  return fmt
 
 
 def _get_tokenizer(cfg):
@@ -314,10 +337,12 @@ def _build_partition_table(records, tokenizer, rng, cfg):
   from ..ops.masking import ragged_indices
   from .common import fused_string_columns
 
+  fmt = resolve_shard_format(cfg)
+  passes = 1 if fmt == DELTA else cfg.duplicate_factor
   documents, flat = documents_from_records_ids(
       records, tokenizer, max_length=cfg.target_seq_length)
   ids_col, triples = [], []
-  for _ in range(cfg.duplicate_factor):
+  for _ in range(passes):
     for document in documents:
       for tr in create_pair_ranges(document, rng,
                                    max_seq_length=cfg.target_seq_length,
@@ -325,7 +350,8 @@ def _build_partition_table(records, tokenizer, rng, cfg):
         ids_col.append(document.doc_id)
         triples.append(tr)
   if not triples:
-    return CODEBERT_SCHEMA.empty_table()
+    return tag_table(CODEBERT_SCHEMA.empty_table(), fmt,
+                     cfg.duplicate_factor)
 
   def _flatten(ranges):
     ranges = np.asarray(ranges, dtype=np.int64)
@@ -353,12 +379,13 @@ def _build_partition_table(records, tokenizer, rng, cfg):
                        type=pa.string())
     code_col = pa.array(tokenizer.decode_join(code_flat, code_offs),
                         type=pa.string())
-  return pa.table({
-      'id': pa.array(ids_col, type=pa.string()),
-      'doc': doc_col,
-      'code': code_col,
-      'num_tokens': pa.array([t[2] for t in triples], type=pa.uint16()),
-  })
+  return tag_table(
+      pa.table({
+          'id': pa.array(ids_col, type=pa.string()),
+          'doc': doc_col,
+          'code': code_col,
+          'num_tokens': pa.array([t[2] for t in triples], type=pa.uint16()),
+      }), fmt, cfg.duplicate_factor)
 
 
 def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg,
@@ -382,8 +409,10 @@ def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg,
     return {b: n for b, (_, n) in out.items()}
   documents = documents_from_records(records, tokenizer,
                                      max_length=cfg.target_seq_length)
+  fmt = resolve_shard_format(cfg)
+  passes = 1 if fmt == DELTA else cfg.duplicate_factor
   instances = []
-  for _ in range(cfg.duplicate_factor):
+  for _ in range(passes):
     for document in documents:
       instances.extend(
           create_pairs_from_document(
@@ -393,7 +422,7 @@ def _process_partition(tgt_idx, global_idx, spill_dir, out_dir, cfg,
               short_seq_prob=cfg.short_seq_prob))
   out = write_samples_partition(
       instances,
-      CODEBERT_SCHEMA,
+      tag_schema(CODEBERT_SCHEMA, fmt, cfg.duplicate_factor),
       out_dir,
       tgt_idx,
       bin_size=cfg.bin_size,
@@ -436,6 +465,12 @@ def attach_args(parser):
   parser.add_argument('--target-seq-length', type=int, default=512)
   parser.add_argument('--short-seq-prob', type=float, default=0.1)
   parser.add_argument('--duplicate-factor', type=int, default=1)
+  parser.add_argument('--shard-format', type=str, default='auto',
+                      choices=['auto', 'materialized', 'delta'],
+                      help='delta stores one pairing pass and the loader '
+                      'expands it duplicate_factor times (dynamic masking '
+                      'differentiates copies); auto: delta iff '
+                      'duplicate_factor>1')
   parser.add_argument('--bin-size', type=int, default=None)
   parser.add_argument('--output-format', type=str, default='parquet',
                       choices=['parquet', 'txt'])
@@ -475,7 +510,8 @@ def main(args=None):
       duplicate_factor=args.duplicate_factor,
       bin_size=args.bin_size,
       seed=args.seed,
-      output_format=args.output_format)
+      output_format=args.output_format,
+      shard_format=args.shard_format)
   t0 = time.perf_counter()
   counts = run(corpus, args.sink, cfg, executor=executor)
   if comm.rank == 0:

@@ -67,6 +67,25 @@ def lookup_digest(run, key, boundary=None):
   return digests[0], hits
 
 
+def corpus_shard_format(build_kwargs):
+  """``(shard_format, duplicate_factor)`` of the corpus a loader spec
+  points at, or ``None`` when the spec has no shard directory (synthetic
+  factories). Replay is format-transparent — the dataset expands
+  mask-delta rows and the collate reconstructs them, so a recorded
+  coordinate replays byte-identically from either format of the same
+  logical corpus — but the verdict should say which format actually
+  backed the reconstruction."""
+  path = dict(build_kwargs).get('path')
+  if path is None:
+    return None
+  try:
+    from ..core.utils import get_all_parquets_under
+    from ..pipeline.shard_format import scan_shard_format
+    return scan_shard_format(get_all_parquets_under(path))
+  except (OSError, ValueError):
+    return None
+
+
 def rematerialize_batch(factory, build_kwargs, epoch, index):
   """Build the loader ``factory(**build_kwargs)`` names, drive its
   epoch-``epoch`` draw sequence from batch 0, and return the batch at
@@ -140,6 +159,7 @@ def replay_coordinate(ledger_path, key, factory, build_kwargs,
         "use 'lddl-replay step' for step coordinates")
   batch = rematerialize_batch(factory, build_kwargs, *pos)
   actual = fingerprint_batch(batch)
+  fmt = corpus_shard_format(build_kwargs)
   return {
       'coordinate': dict(tuple(key)),
       'boundary': boundary or hits[0][1]['boundary'],
@@ -147,6 +167,7 @@ def replay_coordinate(ledger_path, key, factory, build_kwargs,
       'reconstructed': actual,
       'match': actual == digest,
       'algo': algo,
+      'shard_format': fmt[0] if fmt else None,
       'batch': batch,
   }
 
@@ -203,9 +224,11 @@ def replay_smoke(ledger_path, factory, build_kwargs, seed=0, rank=None):
     actual = fingerprint_batch(batch)
     recorded = table[(rec_rank, key)]['digest']
     ok = actual == recorded
+    fmt = corpus_shard_format(kwargs)
     results[bd] = {'status': 'ok' if ok else 'mismatch',
                    'coordinate': dict(key), 'rank': rec_rank,
-                   'recorded': recorded, 'reconstructed': actual}
+                   'recorded': recorded, 'reconstructed': actual,
+                   'shard_format': fmt[0] if fmt else None}
     if not ok:
       rc = 1
   return results, rc

@@ -67,10 +67,18 @@ def _sample_key(row, with_positions):
   scan so the two sides can never disagree on key shape. The stored mask
   positions (when the shard carries them) are part of the key by
   default: random word-salad pairs can collide on (A, B, is_random_next)
-  alone, which made the disjointness assert flake."""
+  alone, which made the disjointness assert flake. Delta-format samples
+  additionally key on their copy index — the ``duplicate_factor``
+  logical samples of one base pair share its text, so the index is what
+  makes them distinct rows of the epoch (drained rows carry
+  ``mask_delta_copy``; the on-disk scan synthesizes it per copy)."""
   key = (row['A'], row['B'], bool(row['is_random_next']))
   if with_positions and 'masked_lm_positions' in row:
     key += (bytes(row['masked_lm_positions']),)
+  if 'mask_delta_positions' in row:
+    key += (int(row['mask_delta_copy']),)
+    if with_positions:
+      key += (bytes(row['mask_delta_positions']),)
   return key
 
 
@@ -100,18 +108,23 @@ def drain_rank_keys(balanced_dir, rank, world, bin_size, base_seed,
 
 
 def expected_min_truncated_rows(balanced_dir):
-  """Rows a full dp drain must yield: every shard file is truncated to
-  its bin's per-file minimum count (loader/dataset.py), ranks stride
-  files — so per bin, ``min(counts) * num_files``."""
+  """Samples a full dp drain must yield: every shard file is truncated
+  to its bin's per-file minimum physical row count (loader/dataset.py),
+  ranks stride files — so per bin, ``min(counts) * num_files``, times
+  the delta expansion factor (a delta row is ``duplicate_factor``
+  logical samples; truncation drops whole copy groups)."""
   from .core import (get_all_bin_ids, get_all_parquets_under,
                      get_file_paths_for_bin_id)
   from .pipeline.parquet_io import read_samples
+  from .pipeline.shard_format import DELTA, scan_shard_format
   paths = get_all_parquets_under(balanced_dir)
+  fmt, dup = scan_shard_format(paths)
+  expansion = dup if fmt == DELTA else 1
   expected = 0
   for b in get_all_bin_ids(paths):
     counts = [len(read_samples(p))
               for p in get_file_paths_for_bin_id(paths, b)]
-    expected += min(counts) * len(counts)
+    expected += min(counts) * len(counts) * expansion
   return expected
 
 
@@ -226,9 +239,19 @@ def check_dp_drains(balanced_dir, world, bin_size, base_seed,
       'dp ranks drained overlapping rows'
   expected = expected_min_truncated_rows(balanced_dir)
   assert len(all_keys) == expected, (len(all_keys), expected)
+  from .pipeline.shard_format import DELTA, scan_shard_format
+  paths = get_all_parquets_under(balanced_dir)
+  fmt, dup = scan_shard_format(paths)
   on_disk = set()
-  for p in get_all_parquets_under(balanced_dir):
+  for p in paths:
     for row in read_samples(p):
-      on_disk.add(_sample_key(row, with_positions))
+      if fmt == DELTA:
+        # A physical delta row is dup logical samples; synthesize the
+        # copy index the drained rows carry.
+        for c in range(dup):
+          on_disk.add(_sample_key(dict(row, mask_delta_copy=c),
+                                  with_positions))
+      else:
+        on_disk.add(_sample_key(row, with_positions))
   assert set(all_keys) <= on_disk
   return len(all_keys)

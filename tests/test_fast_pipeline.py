@@ -135,21 +135,39 @@ def _check_masked_rows(sink, sep_required=True):
   n_rows = 0
   tot_pos = tot_tok = 0
   for p in get_all_parquets_under(sink):
-    for r in pq.read_table(p).to_pylist():
+    t = pq.read_table(p)
+    delta = 'mask_delta_positions' in t.schema.names
+    for r in t.to_pylist():
       a, b = r['A'].split(), r['B'].split()
       n = len(a) + len(b) + 3
       assert r['num_tokens'] == n
-      pos = deserialize_np_array(r['masked_lm_positions'])
-      labels = r['masked_lm_labels'].split()
-      assert pos.dtype == np.uint16
-      assert len(pos) == len(labels) >= 1
-      assert list(pos) == sorted(pos)
-      for p_ in pos:
-        # structural: picked positions are never the [CLS]/[SEP] slots
-        assert 0 < p_ < n - 1 and p_ != len(a) + 1
-      tot_pos += len(pos)
-      tot_tok += n
-      n_rows += 1
+      if delta:
+        # delta format: one base row packs duplicate_factor mask copies
+        pos_all = deserialize_np_array(r['mask_delta_positions'])
+        ks = deserialize_np_array(r['mask_delta_k'])
+        assert pos_all.dtype == np.uint16
+        assert ks.dtype == np.uint16 and len(ks) >= 1
+        copies = []
+        s = 0
+        for k in ks:
+          copies.append(pos_all[s:s + int(k)])
+          s += int(k)
+        assert s == len(pos_all)
+      else:
+        pos = deserialize_np_array(r['masked_lm_positions'])
+        labels = r['masked_lm_labels'].split()
+        assert pos.dtype == np.uint16
+        assert len(pos) == len(labels)
+        copies = [pos]
+      for pos in copies:
+        assert len(pos) >= 1
+        assert list(pos) == sorted(pos)
+        for p_ in pos:
+          # structural: picked positions are never the [CLS]/[SEP] slots
+          assert 0 < p_ < n - 1 and p_ != len(a) + 1
+        tot_pos += len(pos)
+        tot_tok += n
+        n_rows += 1
   assert n_rows > 0
   return n_rows, tot_pos / tot_tok
 

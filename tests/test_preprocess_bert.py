@@ -149,7 +149,8 @@ class TestEndToEnd:
         b = row['bin_id']
         assert b * 8 < row['num_tokens'] <= (b + 1) * 8 or (
             b == 0 and row['num_tokens'] <= 8)
-        assert 'masked_lm_positions' in row
+        # masked dup>1 fast runs default to the delta shard format
+        assert 'mask_delta_positions' in row or 'masked_lm_positions' in row
 
   def test_bit_identical_reruns(self, tmp_corpus, tiny_vocab, tmp_path):
     s1, s2, s3 = (str(tmp_path / n) for n in ('a', 'b', 'c'))

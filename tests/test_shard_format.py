@@ -1,0 +1,516 @@
+"""Mask-delta shard format (pipeline/shard_format.py + the vertical).
+
+The format's one contract: a delta corpus collates **byte-identically**
+to the materialized corpus preprocessed from the same source with the
+same config — at ~1/duplicate_factor of the written bytes. Covered here:
+
+  - the byte-identity matrix: dup in {1, 5} x masking backend (host
+    native / numpy fallback / device) x loader transport (pickle / shm);
+  - the ``lddl-audit diff`` green gate between the two formats' collate
+    ledgers (the CI spelling of the same identity);
+  - mixed-format corpora refused loudly by the balancer and the loader;
+  - delta-aware replay: ``lddl-replay`` rematerializes coordinates from
+    a delta corpus and stamps the format in its verdict;
+  - resume skip math at copy granularity, and the serialization /
+    Arrow-offset-guard helpers the format is packed with.
+"""
+
+import glob
+import os
+import random
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from lddl_tpu.balance import balance_directory
+from lddl_tpu.core.utils import (
+    binary_column_from_parts,
+    deserialize_np_array,
+    npy_batch_binary_parts,
+    serialize_np_array,
+)
+from lddl_tpu.loader.bert import get_bert_pretrain_data_loader
+from lddl_tpu.pipeline.executor import Executor
+from lddl_tpu.pipeline.shard_format import (
+    DELTA,
+    DELTA_COLUMNS,
+    MATERIALIZED,
+    format_of_schema,
+    scan_shard_format,
+    shard_format_of,
+    tag_schema,
+    tag_table,
+)
+from lddl_tpu.preprocess import bert as pb
+from lddl_tpu.preprocess.readers import read_corpus
+
+from test_training import _with_ledger
+
+BERT = ('lddl_tpu.loader.bert', 'get_bert_pretrain_data_loader')
+BIN = 32
+
+
+def _force_numpy_masking():
+  """Worker warmup hook: disable the native masking kernel so the
+  preprocess workers take the bit-identical numpy fallback path."""
+  import lddl_tpu.ops.masking as M
+  M._TOPK_NATIVE = False
+
+
+@pytest.fixture(scope='module')
+def src_corpus(tmp_path_factory):
+  """Module-scoped copy of the conftest tmp_corpus recipe (the format
+  matrix reuses one source for six preprocess runs)."""
+  from conftest import WORDS
+  src = tmp_path_factory.mktemp('fmt_src')
+  r = random.Random(1234)
+  docs = []
+  for d in range(24):
+    sents = []
+    for _ in range(r.randrange(3, 9)):
+      n = r.randrange(4, 12)
+      sents.append(
+          (' '.join(r.choice(WORDS) for _ in range(n)) + '.').capitalize())
+    docs.append(f'doc-{d} ' + ' '.join(sents))
+  for shard in range(4):
+    with open(src / f'{shard}.txt', 'w') as f:
+      for line in docs[shard::4]:
+        f.write(line + '\n')
+  return str(src)
+
+
+@pytest.fixture(scope='module')
+def corpora(tmp_path_factory, src_corpus, tiny_vocab):
+  """``get(fmt, dup, backend) -> (sink_dir, balanced_dir)``, preprocessed
+  and balanced once per combination and cached for the module. The
+  executor pool is persistent (round 6), so one pool per warmup flavor
+  serves every build instead of paying a worker spawn per combination."""
+  root = tmp_path_factory.mktemp('fmt_corpora')
+  cache = {}
+  pools = {}
+
+  def pool(numpy_fallback):
+    if numpy_fallback not in pools:
+      ex = Executor(num_local_workers=1)
+      if numpy_fallback:
+        ex.set_warmup(_force_numpy_masking)
+      pools[numpy_fallback] = ex
+    return pools[numpy_fallback]
+
+  def get(fmt, dup, backend='host'):
+    key = (fmt, dup, backend)
+    if key in cache:
+      return cache[key]
+    tag = f'{fmt}-{dup}-{backend}'
+    sink = str(root / f'sink-{tag}')
+    bal = str(root / f'bal-{tag}')
+    cfg = pb.BertPretrainConfig(
+        vocab_file=tiny_vocab,
+        masking=True,
+        duplicate_factor=dup,
+        bin_size=BIN,
+        target_seq_length=128,
+        seed=42,
+        shard_format=fmt,
+        mask_backend='device' if backend == 'device' else 'host',
+    )
+    pb.run(read_corpus(src_corpus, num_blocks=4), sink, cfg,
+           executor=pool(backend == 'numpy'))
+    balance_directory(sink, bal, 1)
+    cache[key] = (sink, bal)
+    return cache[key]
+
+  yield get
+  for ex in pools.values():
+    ex.close()
+
+
+def _collate_epoch(path, vocab, **kw):
+  base = dict(path=path, vocab_file=vocab, masking='static', bin_size=BIN,
+              max_seq_length=128, batch_size_per_rank=8, base_seed=7,
+              shuffle_buffer_size=16)
+  base.update(kw)
+  return list(get_bert_pretrain_data_loader(**base))
+
+
+def _assert_batches_equal(a, b, ctx):
+  assert len(a) == len(b) and a, f'{ctx}: {len(a)} vs {len(b)} batches'
+  for i, (x, y) in enumerate(zip(a, b)):
+    assert set(x) == set(y), (ctx, i)
+    for k in x:
+      assert np.array_equal(x[k], y[k]), f'{ctx}: batch {i} field {k}'
+
+
+# ---------------------------------------------------------------------------
+# the byte-identity matrix
+
+
+@pytest.fixture(scope='module')
+def materialized_reference(corpora, tiny_vocab):
+  """In-process (num_workers=0) collate of the materialized corpus,
+  cached per (dup, backend). Worker-count/transport invariance of the
+  collate is the repo's own tested contract (test_loader_workers.py),
+  so comparing a worker-transported delta epoch against this reference
+  asserts both the format identity and that invariance at once —
+  without paying a second worker spawn per matrix cell."""
+  cache = {}
+
+  def get(dup, backend):
+    key = (dup, backend)
+    if key not in cache:
+      _, bal = corpora(MATERIALIZED, dup, backend)
+      cache[key] = _collate_epoch(bal, tiny_vocab)
+    return cache[key]
+
+  return get
+
+
+class TestCollateByteIdentity:
+
+  @pytest.mark.parametrize('transport', ['pickle', 'shm'])
+  @pytest.mark.parametrize('backend', ['host', 'numpy', 'device'])
+  def test_matrix(self, corpora, materialized_reference, tiny_vocab,
+                  backend, transport):
+    """Delta collate output == materialized collate output at the
+    headline dup=5 recipe, for every masking backend x worker
+    transport."""
+    bm = materialized_reference(5, backend)
+    _, bal_d = corpora(DELTA, 5, backend)
+    bd = _collate_epoch(bal_d, tiny_vocab, num_workers=1,
+                        transport=transport)
+    _assert_batches_equal(bm, bd, f'dup=5 {backend} {transport}')
+
+  @pytest.mark.parametrize('backend', ['host', 'numpy', 'device'])
+  def test_dup1_identity(self, corpora, materialized_reference, tiny_vocab,
+                         backend):
+    """dup=1 delta corpora (explicit --shard-format delta) collate
+    byte-identically too. In-process: transport is downstream of the
+    collate, so the dup=1 x transport interaction adds no machinery —
+    those cells run in tier 2 below."""
+    bm = materialized_reference(1, backend)
+    _, bal_d = corpora(DELTA, 1, backend)
+    _assert_batches_equal(bm, _collate_epoch(bal_d, tiny_vocab),
+                          f'dup=1 {backend} in-process')
+
+  @pytest.mark.slow
+  @pytest.mark.parametrize('transport', ['pickle', 'shm'])
+  @pytest.mark.parametrize('backend', ['host', 'numpy', 'device'])
+  def test_matrix_dup1_transports(self, corpora, materialized_reference,
+                                  tiny_vocab, backend, transport):
+    """The dup=1 half of the worker-transport matrix (tier 2: each cell
+    pays a worker spawn and duplicates tier-1-covered machinery)."""
+    bm = materialized_reference(1, backend)
+    _, bal_d = corpora(DELTA, 1, backend)
+    bd = _collate_epoch(bal_d, tiny_vocab, num_workers=1,
+                        transport=transport)
+    _assert_batches_equal(bm, bd, f'dup=1 {backend} {transport}')
+
+  def test_epoch_and_sample_arithmetic(self, corpora, tiny_vocab):
+    """A delta corpus reports the same logical sample counts as its
+    materialized twin even though it holds 1/dup the physical rows."""
+    from lddl_tpu.loader.dataset import ParquetShardDataset
+    _, bal_m = corpora(MATERIALIZED, 5, 'host')
+    _, bal_d = corpora(DELTA, 5, 'host')
+    for b in (0, 1, 2):
+      fm = sorted(glob.glob(os.path.join(bal_m, f'*.parquet_{b}')))
+      fd = sorted(glob.glob(os.path.join(bal_d, f'*.parquet_{b}')))
+      dm = ParquetShardDataset(fm)
+      dd = ParquetShardDataset(fd)
+      assert dm.shard_format == MATERIALIZED and dm.duplicate_factor == 1
+      assert dd.shard_format == DELTA and dd.duplicate_factor == 5
+      assert dd.total_samples_per_epoch == dm.total_samples_per_epoch
+
+  def test_dynamic_masking_ignores_deltas(self, corpora, tiny_vocab):
+    """Dynamic masking on a delta corpus masks the expanded base rows;
+    the stored deltas are simply unused (no crash, same batch count)."""
+    _, bal_d = corpora(DELTA, 5, 'host')
+    _, bal_m = corpora(MATERIALIZED, 5, 'host')
+    bd = _collate_epoch(bal_d, tiny_vocab, masking='dynamic')
+    bm = _collate_epoch(bal_m, tiny_vocab, masking='dynamic')
+    assert len(bd) == len(bm) > 0
+
+
+# ---------------------------------------------------------------------------
+# the audit gate: lddl-audit diff between the two formats' ledgers
+
+
+def test_audit_diff_green_between_formats(corpora, tiny_vocab, tmp_path):
+  """The CI spelling of the byte-identity contract: record collate
+  ledgers from one epoch over each format, then ``lddl-audit diff`` must
+  exit 0 (the ledger is enabled around loading only, so no
+  format-specific shard fingerprints enter the comparison)."""
+  from lddl_tpu.telemetry import audit
+  _, bal_m = corpora(MATERIALIZED, 5, 'host')
+  _, bal_d = corpora(DELTA, 5, 'host')
+  dirs = {}
+  for name, bal in (('mat', bal_m), ('delta', bal_d)):
+    led = tmp_path / f'led_{name}'
+    _with_ledger(led, 0, lambda b=bal: _collate_epoch(b, tiny_vocab))
+    dirs[name] = str(led)
+  assert audit.main(['diff', dirs['mat'], dirs['delta']]) == 0
+  # and the gate actually bites: a dup=1 corpus diverges immediately
+  led1 = tmp_path / 'led_dup1'
+  _, bal1 = corpora(DELTA, 1, 'host')
+  _with_ledger(led1, 0, lambda: _collate_epoch(bal1, tiny_vocab))
+  assert audit.main(['diff', dirs['delta'], str(led1)]) == 1
+
+
+def test_perf_gate_judges_dup5_series_and_folds_audit(
+    corpora, tiny_vocab, tmp_path, capsys):
+  """The CI gate over the new format: ``lddl-perf --gate`` judges the
+  ``dup5_mb_per_sec_per_chip`` history series bench.py now stamps, and
+  ``--audit <materialized> <delta>`` folds the format-equivalence audit
+  into the same exit code."""
+  import json
+
+  from lddl_tpu.telemetry.perf import load_history_jsonl, main
+
+  history = tmp_path / 'bench_history.jsonl'
+  with open(history, 'w') as f:
+    for v in (10.4, 10.5, 10.6, 10.5):
+      f.write(json.dumps({'dup5_mb_per_sec_per_chip': v,
+                          'shard_format': 'delta'}) + '\n')
+  series = load_history_jsonl(str(history))
+  assert series['dup5_mb_per_sec_per_chip'] == [10.4, 10.5, 10.6, 10.5]
+
+  _, bal_m = corpora(MATERIALIZED, 5, 'host')
+  _, bal_d = corpora(DELTA, 5, 'host')
+  led_m, led_d = tmp_path / 'led_m', tmp_path / 'led_d'
+  _with_ledger(led_m, 0, lambda: _collate_epoch(bal_m, tiny_vocab))
+  _with_ledger(led_d, 0, lambda: _collate_epoch(bal_d, tiny_vocab))
+  assert main(['--root', str(tmp_path), '--gate',
+               '--audit', str(led_d), str(led_m)]) == 0
+  capsys.readouterr()
+
+  # a dup=5 throughput cliff in the history fails the same command
+  with open(history, 'a') as f:
+    f.write(json.dumps({'dup5_mb_per_sec_per_chip': 5.0,
+                        'shard_format': 'delta'}) + '\n')
+  assert main(['--root', str(tmp_path), '--gate',
+               '--audit', str(led_d), str(led_m)]) == 1
+  out = capsys.readouterr().out
+  assert 'dup5_mb_per_sec_per_chip' in out
+
+
+# ---------------------------------------------------------------------------
+# mixed corpora are refused
+
+
+def _mini_table(tagged_fmt=None, dup=1):
+  t = pa.table({'A': pa.array(['alpha bravo']), 'B': pa.array(['kilo lima']),
+                'is_random_next': pa.array([False]),
+                'num_tokens': pa.array([7], type=pa.uint16())})
+  if tagged_fmt:
+    t = tag_table(t, tagged_fmt, dup)
+  return t
+
+
+class TestMixedCorpusRefusal:
+
+  def test_scan_agrees(self, tmp_path):
+    for i in range(3):
+      pq.write_table(_mini_table(DELTA, 5), str(tmp_path / f's{i}.parquet'))
+    paths = sorted(glob.glob(str(tmp_path / '*.parquet')))
+    assert scan_shard_format(paths) == (DELTA, 5)
+
+  def test_scan_empty_is_materialized(self):
+    assert scan_shard_format([]) == (MATERIALIZED, 1)
+
+  def test_untagged_reads_as_materialized(self, tmp_path):
+    p = str(tmp_path / 'legacy.parquet')
+    pq.write_table(_mini_table(), p)
+    assert shard_format_of(p) == (MATERIALIZED, 1)
+
+  def test_materialized_dup_stamps_are_provenance_only(self, tmp_path):
+    """Materialized shards with different dup stamps (or no tag at all)
+    are one corpus: dup is provenance there, not expansion."""
+    pq.write_table(_mini_table(MATERIALIZED, 5), str(tmp_path / 'a.parquet'))
+    pq.write_table(_mini_table(), str(tmp_path / 'b.parquet'))
+    paths = sorted(glob.glob(str(tmp_path / '*.parquet')))
+    assert scan_shard_format(paths) == (MATERIALIZED, 1)
+
+  def test_mixed_formats_refused(self, tmp_path):
+    pq.write_table(_mini_table(DELTA, 5), str(tmp_path / 'a.parquet'))
+    pq.write_table(_mini_table(), str(tmp_path / 'b.parquet'))
+    paths = sorted(glob.glob(str(tmp_path / '*.parquet')))
+    with pytest.raises(ValueError, match='mixed shard formats'):
+      scan_shard_format(paths)
+
+  def test_delta_dup_disagreement_refused(self, tmp_path):
+    pq.write_table(_mini_table(DELTA, 5), str(tmp_path / 'a.parquet'))
+    pq.write_table(_mini_table(DELTA, 2), str(tmp_path / 'b.parquet'))
+    with pytest.raises(ValueError, match='mixed shard formats'):
+      scan_shard_format(sorted(glob.glob(str(tmp_path / '*.parquet'))))
+
+  def test_balancer_refuses_mixed(self, tmp_path):
+    sink = tmp_path / 'sink'
+    sink.mkdir()
+    pq.write_table(_mini_table(DELTA, 5), str(sink / 'a.parquet'))
+    pq.write_table(_mini_table(), str(sink / 'b.parquet'))
+    with pytest.raises(ValueError, match='mixed shard formats'):
+      balance_directory(str(sink), str(tmp_path / 'out'), 1)
+
+  def test_loader_refuses_mixed(self, tmp_path):
+    from lddl_tpu.loader.dataset import ParquetShardDataset
+    pq.write_table(_mini_table(DELTA, 5), str(tmp_path / 'a.parquet'))
+    pq.write_table(_mini_table(), str(tmp_path / 'b.parquet'))
+    with pytest.raises(ValueError, match='mixed shard formats'):
+      ParquetShardDataset(sorted(glob.glob(str(tmp_path / '*.parquet'))))
+
+  def test_schema_tag_roundtrip(self):
+    s = tag_schema(_mini_table().schema, DELTA, 3)
+    assert format_of_schema(s) == (DELTA, 3)
+    with pytest.raises(ValueError, match='unknown shard format'):
+      tag_schema(_mini_table().schema, 'sparse', 1)
+
+
+# ---------------------------------------------------------------------------
+# replay from a delta corpus
+
+
+def test_replay_byte_identity_on_delta_corpus(corpora, tiny_vocab, tmp_path):
+  """lddl-replay rematerializes a recorded coordinate from a delta
+  corpus byte-identically and stamps the backing format in its verdict."""
+  from lddl_tpu.replay import replay_coordinate
+  _, bal_d = corpora(DELTA, 5, 'host')
+  kw = dict(path=bal_d, vocab_file=tiny_vocab, masking='static',
+            bin_size=BIN, max_seq_length=128, batch_size_per_rank=8,
+            base_seed=7, shuffle_buffer_size=16)
+
+  def record():
+    for _ in get_bert_pretrain_data_loader(**kw):
+      pass
+
+  _with_ledger(tmp_path / 'led', 0, record)
+  res = replay_coordinate(str(tmp_path / 'led'), (('epoch', 0), ('index', 2)),
+                          BERT, kw, boundary='collate')
+  assert res['match'] is True, res
+  assert res['shard_format'] == DELTA
+
+
+# ---------------------------------------------------------------------------
+# resume skip math at copy granularity
+
+
+def test_row_stream_skip_copies(corpora, tiny_vocab):
+  """``samples_to_skip`` on a delta corpus skips whole physical rows and
+  then the leading copies of the first emitted row: the unshuffled
+  stream with a skip is exactly the suffix of the full stream."""
+  from lddl_tpu.loader.dataset import ParquetShardDataset
+  _, bal_d = corpora(DELTA, 5, 'host')
+  files = sorted(glob.glob(os.path.join(bal_d, '*.parquet_1')))
+  ds = ParquetShardDataset(files)
+  full = [r.to_dict() for r in ds._row_stream(files, 0, 0, 0)]
+  assert len(full) == ds.total_samples_per_epoch
+  for skip in (1, 4, 5, 7, ds.duplicate_factor * ds._rows_per_file + 3):
+    skip_files = skip // ds.samples_per_file
+    rem = skip % ds.samples_per_file
+    suffix = [
+        r.to_dict() for r in ds._row_stream(
+            files, skip_files, rem // ds.duplicate_factor,
+            rem % ds.duplicate_factor)
+    ]
+    assert suffix == full[skip:], f'skip={skip}'
+  # every logical sample carries its copy index for the collate
+  copies = [r['mask_delta_copy'] for r in full]
+  assert copies[:10] == [0, 1, 2, 3, 4, 0, 1, 2, 3, 4]
+
+
+def test_resume_mid_group_via_loader(corpora, tiny_vocab):
+  """The public samples_seen resume path lands mid-copy-group without
+  error and keeps batch shapes (the stream suffix contract is resume
+  semantics, not byte identity — same as materialized corpora)."""
+  _, bal_d = corpora(DELTA, 5, 'host')
+  batches = _collate_epoch(bal_d, tiny_vocab, samples_seen=7)
+  assert batches and all(b['input_ids'].shape[0] == 8 for b in batches)
+
+
+# ---------------------------------------------------------------------------
+# packing helpers
+
+
+class TestPackingHelpers:
+
+  @pytest.mark.parametrize('dtype', ['<u2', '<i4'])
+  def test_npy_batch_binary_parts_matches_serializer(self, dtype):
+    """The batched npy framing is byte-identical to serialize_np_array
+    applied per segment — the collate deserializes with the same
+    np.load-compatible reader either way."""
+    rng = np.random.default_rng(5)
+    lens = rng.integers(0, 9, 17)
+    offs = np.zeros(len(lens) + 1, np.int64)
+    np.cumsum(lens, out=offs[1:])
+    vals = rng.integers(0, 30000, int(offs[-1])).astype(np.dtype(dtype))
+    boffs, bdata = npy_batch_binary_parts(vals, offs, dtype)
+    for i in range(len(lens)):
+      got = bytes(bdata[boffs[i]:boffs[i + 1]])
+      want = serialize_np_array(vals[offs[i]:offs[i + 1]])
+      assert got == want, f'segment {i}'
+      assert np.array_equal(deserialize_np_array(got),
+                            vals[offs[i]:offs[i + 1]])
+
+  def test_offset_guard_raises_past_2gib(self):
+    boffs = np.array([0, (1 << 31) + 8], np.int64)
+    with pytest.raises(ValueError, match='2 GiB'):
+      binary_column_from_parts(boffs, np.zeros(8, np.uint8), 1, 'mask_delta_k')
+
+  def test_delta_columns_are_npy_framed(self, corpora):
+    """On-disk check: every delta column of a real shard deserializes
+    per-row into arrays whose per-copy segment lengths agree with k."""
+    sink_d, _ = corpora(DELTA, 5, 'host')
+    checked = 0
+    for p in glob.glob(os.path.join(sink_d, '*.parquet*')):
+      t = pq.read_table(p)
+      assert format_of_schema(t.schema) == (DELTA, 5)
+      for name in DELTA_COLUMNS:
+        assert name in t.schema.names
+      for row in t.to_pylist():
+        ks = deserialize_np_array(row['mask_delta_k'])
+        assert ks.shape == (5,) and (ks >= 1).all()
+        pos = deserialize_np_array(row['mask_delta_positions'])
+        new = deserialize_np_array(row['mask_delta_new_ids'])
+        assert pos.shape[0] == new.shape[0] == int(ks.sum())
+        checked += 1
+    assert checked > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI / config plumbing
+
+
+class TestShardFormatConfig:
+
+  def test_auto_resolution(self, tiny_vocab):
+    mk = lambda **kw: pb.BertPretrainConfig(vocab_file=tiny_vocab, **kw)
+    assert pb.resolve_shard_format(
+        mk(masking=True, duplicate_factor=5)) == DELTA
+    assert pb.resolve_shard_format(
+        mk(masking=True, duplicate_factor=1)) == MATERIALIZED
+    assert pb.resolve_shard_format(
+        mk(masking=False, duplicate_factor=5)) == MATERIALIZED
+    assert pb.resolve_shard_format(
+        mk(masking=True, duplicate_factor=5,
+           engine='python')) == MATERIALIZED
+
+  def test_explicit_delta_requires_masking_and_fast_engine(self, tiny_vocab):
+    with pytest.raises(ValueError, match='mask delta'):
+      pb.resolve_shard_format(
+          pb.BertPretrainConfig(vocab_file=tiny_vocab, masking=False,
+                                duplicate_factor=5, shard_format='delta'))
+    with pytest.raises(ValueError):
+      pb.resolve_shard_format(
+          pb.BertPretrainConfig(vocab_file=tiny_vocab, masking=True,
+                                engine='python', shard_format='delta'))
+    with pytest.raises(ValueError, match='unknown'):
+      pb.resolve_shard_format(
+          pb.BertPretrainConfig(vocab_file=tiny_vocab, shard_format='zip'))
+
+  def test_delta_schema_has_no_label_column(self):
+    s = pb.bert_schema(True, DELTA)
+    assert set(DELTA_COLUMNS) <= set(s.names)
+    assert 'masked_lm_labels' not in s.names
+    assert 'masked_lm_positions' not in s.names
+    with pytest.raises(ValueError, match='requires masking'):
+      pb.bert_schema(False, DELTA)
