@@ -97,13 +97,20 @@ def _lint_status():
   omits the fields.
   """
   try:
-    from lddl_tpu.analysis import LINT_SCHEMA_VERSION, analyze_package
+    from lddl_tpu.analysis import (CONCURRENCY_RULE_IDS,
+                                   LINT_SCHEMA_VERSION, analyze_package)
     unsuppressed, suppressed = analyze_package()
+    conc = [f for f in unsuppressed if f.rule_id in CONCURRENCY_RULE_IDS]
+    conc_sup = [f for f in suppressed if f.rule_id in CONCURRENCY_RULE_IDS]
     return {
         'lint_schema': LINT_SCHEMA_VERSION,
         'lint_clean': not unsuppressed,
         'lint_findings': len(unsuppressed),
         'lint_suppressed': len(suppressed),
+        # the thread-graph rules broken out: a bench number captured on
+        # a tree with an open race/deadlock finding is not trustworthy
+        'lint_concurrency_findings': len(conc),
+        'lint_concurrency_suppressed': len(conc_sup),
     }
   except Exception:
     return {}

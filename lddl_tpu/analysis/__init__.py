@@ -18,6 +18,11 @@ Layout:
     across modules, ``ProjectRule`` base, ``analyze_project``;
   - :mod:`.callgraph`: deterministic call graph, transitive effect
     sets, call-chain traces;
+  - :mod:`.concurrency`: thread graph (spawn edges), shared-state
+    access sets, lockset inference, and the LDA014–LDA018 concurrency
+    rules;
+  - :mod:`.cache`: content-hash incremental cache for findings and
+    per-module facts (``LDDL_ANALYZE_CACHE``);
   - :mod:`.rules`: the per-file LDA001–LDA007 and interprocedural
     LDA008–LDA011 rulesets;
   - :mod:`.findings`: the finding model (file:line, rule id, fix hint,
@@ -29,6 +34,8 @@ Layout:
 
 import os
 
+from .cache import AnalysisCache, cache_from_env
+from .concurrency import CONCURRENCY_RULE_IDS
 from .engine import (
     Rule,
     analyze_file,
@@ -39,29 +46,35 @@ from .findings import Finding
 from .project import ProjectRule, analyze_project
 from .rules import all_rules, default_rules, project_rules, rules_by_id
 
-# Schema of the lint status dict / --format json document.
-LINT_SCHEMA_VERSION = 2
+# Schema of the lint status dict / --format json document. v3 adds the
+# labeled multi-chain traces (``chains``) the concurrency rules emit.
+LINT_SCHEMA_VERSION = 3
 
 
-def analyze_package(rules=None, jobs=None):
+def analyze_package(rules=None, jobs=None, cache=None):
   """Run the analyzer — project mode, full call graph — over the
   installed ``lddl_tpu`` tree itself.
 
   Returns ``(unsuppressed, suppressed)`` finding lists — the self-check
-  test and ``bench.py``'s lint-status stamp both go through here.
+  test, ``bench.py``'s lint-status stamp, and the ``lddl-perf --gate``
+  concurrency leg all go through here.
   """
   root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-  findings, _ = analyze_project([root], rules=rules, jobs=jobs)
+  findings, _ = analyze_project([root], rules=rules, jobs=jobs,
+                                cache=cache)
   return ([f for f in findings if not f.suppressed],
           [f for f in findings if f.suppressed])
 
 
 __all__ = [
+    'AnalysisCache',
+    'CONCURRENCY_RULE_IDS',
     'Finding',
     'LINT_SCHEMA_VERSION',
     'ProjectRule',
     'Rule',
     'all_rules',
+    'cache_from_env',
     'analyze_file',
     'analyze_package',
     'analyze_paths',

@@ -20,8 +20,15 @@ finding. ``--no-project`` restricts to the per-file rules;
 ``--project`` forces the whole-program pass even for file targets.
 ``--changed`` implies ``--no-project`` unless ``--project`` is given
 (a partial file list can't support whole-program claims); with both,
-the graph is built over the full tree and only findings in changed
-files are reported.
+the per-file pass runs only over the changed files while the call
+graph is still built over the full tree, and a project finding is
+reported when the changed set touches *any* hop of its call chains —
+a small diff re-runs exactly the interprocedural claims it can affect.
+
+Setting ``LDDL_ANALYZE_CACHE`` to a directory enables the incremental
+cache: per-file findings and per-module facts are keyed by content
+hash, so a warm run over an unchanged tree skips parsing entirely and
+produces byte-identical output. ``--no-cache`` bypasses it.
 
 Exit status: 0 when every finding is pragma-suppressed (or none exist),
 1 when unsuppressed findings remain, 2 on usage errors. The tier-1
@@ -36,12 +43,13 @@ import os
 import subprocess
 import sys
 
+from .cache import cache_from_env
 from .engine import Rule, analyze_paths, discover_py_files
 from .project import ProjectRule, analyze_project
 from .rules import all_rules, rules_by_id
 from .sarif import to_sarif
 
-JSON_SCHEMA_VERSION = 2
+JSON_SCHEMA_VERSION = 3
 
 
 def _git_changed_files(diff_base):
@@ -92,12 +100,27 @@ def build_parser():
   parser.add_argument('--diff-base', default='HEAD',
                       help='git ref --changed diffs against '
                       '(default: HEAD)')
+  parser.add_argument('--no-cache', action='store_true',
+                      help='ignore LDDL_ANALYZE_CACHE and recompute '
+                      'everything')
   parser.add_argument('--show-suppressed', action='store_true',
                       help='also print pragma-suppressed findings in '
                       'text mode')
   parser.add_argument('--list-rules', action='store_true',
                       help='print the rule table and exit')
   return parser
+
+
+def _touches(finding, file_filter):
+  """Whether a finding concerns any file in the ``--changed`` set: its
+  anchor file, or any hop of any of its call chains (a changed callee
+  re-surfaces the project findings that flow through it)."""
+  if os.path.abspath(finding.path) in file_filter:
+    return True
+  chains = finding.chains or (
+      [{'hops': finding.chain}] if finding.chain else [])
+  return any(os.path.abspath(hop['path']) in file_filter
+             for entry in chains for hop in entry['hops'])
 
 
 def _select_rules(spec):
@@ -155,12 +178,13 @@ def main(args=None):
                     (any(os.path.isdir(p) for p in paths)
                      or selected_project_rule))
 
+  cache = cache_from_env(no_cache=opts.no_cache)
   if project_mode:
-    findings, files_scanned = analyze_project(paths, rules=rules,
-                                              jobs=opts.jobs)
+    findings, files_scanned = analyze_project(
+        paths, rules=rules, jobs=opts.jobs, file_filter=file_filter,
+        cache=cache)
     if file_filter is not None:
-      findings = [f for f in findings
-                  if os.path.abspath(f.path) in file_filter]
+      findings = [f for f in findings if _touches(f, file_filter)]
   else:
     file_rules = (None if rules is None
                   else [r for r in rules if isinstance(r, Rule)])
@@ -168,10 +192,12 @@ def main(args=None):
       files = [f for f in discover_py_files(paths)
                if os.path.abspath(f) in file_filter]
       findings, files_scanned = analyze_paths(files, rules=file_rules,
-                                              jobs=opts.jobs)
+                                              jobs=opts.jobs,
+                                              cache=cache)
     else:
       findings, files_scanned = analyze_paths(paths, rules=file_rules,
-                                              jobs=opts.jobs)
+                                              jobs=opts.jobs,
+                                              cache=cache)
 
   unsuppressed = [f for f in findings if not f.suppressed]
   suppressed = [f for f in findings if f.suppressed]

@@ -278,6 +278,14 @@ JIT_WRAPPERS = frozenset({'jit', 'shard_map', 'pallas_call',
 # and ``q.get(timeout=...)`` out.
 UNBOUNDED_WAIT_ATTRS = frozenset({'join', 'wait', 'acquire', 'get'})
 
+# Method calls that mutate their receiver in place: ``self.buf.append(x)``
+# is a *write* to the shared object behind ``self.buf``, not a read.
+MUTATOR_METHODS = frozenset({
+    'append', 'appendleft', 'extend', 'extendleft', 'insert', 'add',
+    'update', 'pop', 'popleft', 'popitem', 'remove', 'discard', 'clear',
+    'setdefault', 'sort', 'reverse', 'rotate',
+})
+
 
 @dataclasses.dataclass
 class CallSite:
@@ -291,6 +299,7 @@ class CallSite:
   nkw: int
   arg0: str          # dotted name of first positional arg, or ''
   rank_cond: str     # gating rank identifier when under a rank branch
+  locks: tuple = ()  # dotted `with` contexts lexically held at the call
 
 
 @dataclasses.dataclass
@@ -300,6 +309,40 @@ class EffectSite:
   detail: str
   line: int
   col: int
+
+
+@dataclasses.dataclass
+class AccessSite:
+  """One read or write of shared state inside a definition: a ``self.X``
+  attribute (``scope='self'``) or a module global (``scope='global'``,
+  recorded only in modules with a ``global`` statement naming it)."""
+  attr: str          # attribute / global name
+  kind: str          # 'read' | 'write'
+  scope: str         # 'self' | 'global'
+  line: int
+  col: int
+  locks: tuple = ()  # dotted `with` contexts lexically held at the access
+
+
+@dataclasses.dataclass
+class SpawnSite:
+  """One ``Thread(target=...)`` / ``Process(target=...)`` construction."""
+  ctor: str          # 'Thread' | 'Process'
+  target: str        # dotted target name ('' for lambdas/opaque values)
+  binding: str       # name the object binds to: 'self.X', a local, or ''
+  daemon: object     # True/False when a literal daemon= kwarg, else None
+  line: int
+  col: int
+
+
+@dataclasses.dataclass
+class AcquireSite:
+  """One ``with <lock-like name>:`` entry (no-call context expressions
+  only — ``with open(...)`` is a resource, never a lock candidate)."""
+  name: str          # dotted context name ('self._lock', 'window_lock')
+  line: int
+  col: int
+  held: tuple = ()   # dotted contexts already held when this one enters
 
 
 @dataclasses.dataclass
@@ -322,6 +365,9 @@ class DefFacts:
   effects: list      # [EffectSite]
   var_ctors: dict    # local var -> dotted ctor name it was built from
   branches: list     # [BranchFacts]
+  accesses: list = dataclasses.field(default_factory=list)  # [AccessSite]
+  spawns: list = dataclasses.field(default_factory=list)    # [SpawnSite]
+  acquires: list = dataclasses.field(default_factory=list)  # [AcquireSite]
 
 
 @dataclasses.dataclass
@@ -340,6 +386,9 @@ class ModuleFacts:
   jit_roots: list    # [(arg0_dotted, scope_qualname, line)] from
                      # jit(f)/shard_map(f)/pallas_call(f)/CompiledStepCache(f)
   aliases: dict      # local name -> dotted origin (for re-export chasing)
+  signal_handlers: list = dataclasses.field(default_factory=list)
+                     # [(handler_dotted, scope_qualname, line)] from
+                     # signal.signal(sig, handler) registrations
 
 
 def _scope_chain(ancestors, node):
@@ -368,6 +417,53 @@ def _owner_def_qualname(scopes):
   if idx is None:
     return ''
   return '.'.join(s.name for s in scopes[:idx + 1])
+
+
+def _with_locks(chain, aliases):
+  """Dotted ``with``-context names lexically held at the innermost node
+  of ``chain`` (ancestors + node, outermost first): every ``with`` whose
+  *body* the path passes through, inside the innermost enclosing
+  function. Only plain Name/Attribute contexts count — ``with
+  open(...)`` is a resource, not a lock candidate — and contexts from an
+  enclosing def don't leak into nested defs (which run later, lock-free).
+  """
+  last_def = -1
+  for i, anc in enumerate(chain[:-1]):
+    if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+        and any(chain[i + 1] is stmt for stmt in anc.body):
+      last_def = i
+  held = []
+  for i, anc in enumerate(chain[:-1]):
+    if i <= last_def or not isinstance(anc, (ast.With, ast.AsyncWith)):
+      continue
+    if not any(chain[i + 1] is stmt for stmt in anc.body):
+      continue
+    for item in anc.items:
+      dotted = _qual_of(item.context_expr, aliases)
+      if dotted:
+        held.append(dotted)
+  return tuple(held)
+
+
+def _access_kind(node, ancestors):
+  """'read'/'write' for an attribute/name access node. A Store/Del
+  context, a store through a subscript or sub-attribute
+  (``self.X[k] = v``, ``self.X.y = v``), and an in-place mutator call
+  (``self.X.append(v)``) are all writes to the shared object."""
+  if isinstance(node.ctx, (ast.Store, ast.Del)):
+    return 'write'
+  parent = ancestors[-1] if ancestors else None
+  if isinstance(parent, ast.Attribute) and parent.value is node:
+    if isinstance(parent.ctx, (ast.Store, ast.Del)):
+      return 'write'
+    gp = ancestors[-2] if len(ancestors) >= 2 else None
+    if (isinstance(gp, ast.Call) and gp.func is parent
+        and parent.attr in MUTATOR_METHODS):
+      return 'write'
+  elif (isinstance(parent, ast.Subscript) and parent.value is node
+        and isinstance(parent.ctx, (ast.Store, ast.Del))):
+    return 'write'
+  return 'read'
 
 
 def _arm_of(if_node, child):
@@ -468,10 +564,19 @@ def extract_module_facts(tree, path, aliases=None):
   defs = {}
   classes = {}
   jit_roots = []
+  signal_handlers = []
   # def qualname -> [(CallSite, [(if line, arm)])]; sorted per def at the end
   raw_calls = {}
   # def qualname -> {if line: If node}
   def_ifs = {}
+  # Names declared ``global`` anywhere in the module: accesses to these
+  # are shared state worth tracking. Collected up front because the main
+  # walk's traversal order gives no ordering guarantee between a
+  # ``global`` statement and the uses it governs.
+  global_names = set()
+  for n in ast.walk(tree):
+    if isinstance(n, ast.Global):
+      global_names.update(n.names)
 
   for node, ancestors in walk_with_ancestors(tree):
     if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -522,6 +627,38 @@ def extract_module_facts(tree, path, aliases=None):
       def_ifs.setdefault(owner, {})[node.lineno] = node
       continue
 
+    if isinstance(node, (ast.With, ast.AsyncWith)) and owner in defs:
+      held = list(_with_locks(list(ancestors) + [node], aliases))
+      for item in node.items:
+        dotted = _qual_of(item.context_expr, aliases)
+        if dotted:
+          defs[owner].acquires.append(AcquireSite(
+              name=dotted, line=node.lineno, col=node.col_offset + 1,
+              held=tuple(held)))
+          held.append(dotted)  # `with a, b:` — b enters with a held
+      # fall through: nothing else to record on the With node itself
+
+    if (isinstance(node, ast.Attribute) and owner in defs
+        and isinstance(node.value, ast.Name) and node.value.id == 'self'):
+      parent = ancestors[-1] if ancestors else None
+      # `self.method()` is a call (a CallSite), not a state access.
+      if not (isinstance(parent, ast.Call) and parent.func is node):
+        defs[owner].accesses.append(AccessSite(
+            attr=node.attr, kind=_access_kind(node, ancestors),
+            scope='self', line=node.lineno, col=node.col_offset + 1,
+            locks=_with_locks(list(ancestors) + [node], aliases)))
+      continue
+
+    if (global_names and isinstance(node, ast.Name)
+        and node.id in global_names and owner in defs):
+      parent = ancestors[-1] if ancestors else None
+      if not (isinstance(parent, ast.Call) and parent.func is node):
+        defs[owner].accesses.append(AccessSite(
+            attr=node.id, kind=_access_kind(node, ancestors),
+            scope='global', line=node.lineno, col=node.col_offset + 1,
+            locks=_with_locks(list(ancestors) + [node], aliases)))
+      continue
+
     if not isinstance(node, ast.Call):
       continue
 
@@ -540,8 +677,36 @@ def extract_module_facts(tree, path, aliases=None):
       if arg0_fn:
         jit_roots.append((arg0_fn, owner, node.lineno))
 
+    if dotted == 'signal.signal' and len(node.args) >= 2:
+      handler = _qual_of(node.args[1], aliases) or ''
+      if handler:  # lambdas/opaque handlers can't be followed
+        signal_handlers.append((handler, owner, node.lineno))
+
     if not owner or owner not in defs:
       continue
+
+    if (terminal in ('Thread', 'Process')
+        and any(kw.arg == 'target' for kw in node.keywords)):
+      target, daemon = '', None
+      for kw in node.keywords:
+        if kw.arg == 'target':
+          target = _qual_of(kw.value, aliases) or ''
+        elif kw.arg == 'daemon' and isinstance(kw.value, ast.Constant):
+          daemon = bool(kw.value.value)
+      binding = ''
+      parent = ancestors[-1] if ancestors else None
+      if (isinstance(parent, ast.Assign) and parent.value is node
+          and len(parent.targets) == 1):
+        tgt_node = parent.targets[0]
+        if isinstance(tgt_node, ast.Name):
+          binding = tgt_node.id
+        elif (isinstance(tgt_node, ast.Attribute)
+              and isinstance(tgt_node.value, ast.Name)
+              and tgt_node.value.id == 'self'):
+          binding = f'self.{tgt_node.attr}'
+      defs[owner].spawns.append(SpawnSite(
+          ctor=terminal, target=target, binding=binding, daemon=daemon,
+          line=node.lineno, col=node.col_offset + 1))
 
     # Innermost owning def node: If-ancestors beyond it gate this call.
     owner_node = None
@@ -575,7 +740,7 @@ def extract_module_facts(tree, path, aliases=None):
         dotted=dotted, terminal=terminal, receiver=receiver,
         line=node.lineno, col=node.col_offset + 1,
         nargs=len(node.args), nkw=len(node.keywords), arg0=arg0,
-        rank_cond=rank_cond)
+        rank_cond=rank_cond, locks=_with_locks(chain, aliases))
     raw_calls.setdefault(owner, []).append((site, arms))
     for kind, detail in _call_effects(node, dotted, terminal, receiver,
                                       aliases):
@@ -599,9 +764,14 @@ def extract_module_facts(tree, path, aliases=None):
                       orelse=arms['orelse']))
   for facts in defs.values():
     facts.effects.sort(key=lambda e: (e.line, e.col, e.kind))
+    facts.accesses.sort(key=lambda a: (a.line, a.col, a.attr, a.kind))
+    facts.spawns.sort(key=lambda s: (s.line, s.col))
+    facts.acquires.sort(key=lambda a: (a.line, a.col, a.name))
   jit_roots.sort(key=lambda r: (r[2], r[0]))
+  signal_handlers.sort(key=lambda r: (r[2], r[0]))
   return ModuleFacts(path=path, defs=defs, classes=classes,
-                     jit_roots=jit_roots, aliases=dict(aliases))
+                     jit_roots=jit_roots, aliases=dict(aliases),
+                     signal_handlers=signal_handlers)
 
 
 def analyze_source(source, path='<string>', rules=None):
@@ -710,7 +880,18 @@ def resolve_jobs(jobs=None):
   return jobs if jobs and jobs > 0 else (os.cpu_count() or 1)
 
 
-def analyze_paths(paths, rules=None, jobs=None):
+def _cache_fingerprint(rule_ids):
+  """Stable ruleset fingerprint for findings-cache keys, or None when
+  the rules are custom instances (no stable identity → no caching)."""
+  if rule_ids == ():
+    return None
+  if rule_ids is None:
+    from .rules import default_rules
+    rule_ids = [r.rule_id for r in default_rules()]
+  return ','.join(sorted(rule_ids))
+
+
+def analyze_paths(paths, rules=None, jobs=None, cache=None):
   """Analyze every ``.py`` file under ``paths`` (files or directories).
 
   Returns ``(findings, files_scanned)``; findings include suppressed
@@ -722,11 +903,37 @@ def analyze_paths(paths, rules=None, jobs=None):
   file's findings are internally sorted, so the output is byte-identical
   to the serial run at any worker count. Custom (non-registry) rule
   instances can't travel to workers and fall back to the serial loop.
+
+  With a ``cache`` (:class:`~lddl_tpu.analysis.cache.AnalysisCache`),
+  unchanged files load their findings by content hash and only the
+  misses are analyzed; suppression state travels with the cached
+  findings (pragmas live in the hashed source), so warm output is
+  byte-identical to cold.
   """
   files = discover_py_files(paths)
   jobs = resolve_jobs(jobs)
   rule_ids = _serializable_rule_ids(rules)
-  parallel_ok = (jobs > 1 and len(files) >= _PARALLEL_MIN_FILES
+  fingerprint = _cache_fingerprint(rule_ids) if cache is not None else None
+  per_file = {}
+  pending = list(files)
+  sources = {}
+  if fingerprint is not None:
+    pending = []
+    for path in files:
+      try:
+        with open(path, encoding='utf-8') as fh:
+          sources[path] = fh.read()
+      except OSError:
+        pending.append(path)  # unreadable now: let analyze_file report
+        continue
+      hit = cache.load('findings', path, sources[path],
+                       extra=fingerprint)
+      if hit is None:
+        pending.append(path)
+      else:
+        per_file[path] = hit
+  analyzed = None
+  parallel_ok = (jobs > 1 and len(pending) >= _PARALLEL_MIN_FILES
                  and rule_ids != ())
   if parallel_ok:
     try:
@@ -735,17 +942,20 @@ def analyze_paths(paths, rules=None, jobs=None):
       ctx = multiprocessing.get_context()
     try:
       with concurrent.futures.ProcessPoolExecutor(
-          max_workers=min(jobs, len(files)), mp_context=ctx) as pool:
-        per_file = list(
-            pool.map(_analyze_file_worker, files,
-                     [rule_ids] * len(files),
-                     chunksize=max(1, len(files) // (jobs * 4))))
-      findings = [f for batch in per_file for f in batch]
-      return findings, len(files)
+          max_workers=min(jobs, len(pending)), mp_context=ctx) as pool:
+        analyzed = list(
+            pool.map(_analyze_file_worker, pending,
+                     [rule_ids] * len(pending),
+                     chunksize=max(1, len(pending) // (jobs * 4))))
     except (OSError, ValueError, concurrent.futures.process
             .BrokenProcessPool):
-      pass  # restricted environments: fall back to the serial loop
-  findings = []
-  for path in files:
-    findings.extend(analyze_file(path, rules=rules))
+      analyzed = None  # restricted environments: serial fallback below
+  if analyzed is None:
+    analyzed = [analyze_file(path, rules=rules) for path in pending]
+  for path, batch in zip(pending, analyzed):
+    per_file[path] = batch
+    if fingerprint is not None and path in sources:
+      cache.store('findings', path, sources[path], batch,
+                  extra=fingerprint)
+  findings = [f for path in files for f in per_file.get(path, ())]
   return findings, len(files)

@@ -19,6 +19,12 @@ class Finding:
   path from the analysis root to the effect site, as a list of
   ``{'name', 'path', 'line'}`` hops ending at the hazardous call itself.
   Per-file findings leave it ``None``.
+
+  Concurrency findings relate *two* execution paths (the writer's thread
+  chain and the reader's main chain); those carry ``chains`` — a list of
+  ``{'label', 'hops'}`` entries, each ``hops`` shaped like ``chain``.
+  When ``chains`` is set, ``chain`` mirrors its first entry's hops so
+  single-chain consumers keep working.
   """
 
   rule_id: str
@@ -30,17 +36,21 @@ class Finding:
   end_line: int = 0  # last source line of the flagged node (pragma window)
   suppressed: bool = False
   chain: list = None  # call-chain trace (project mode), else None
+  chains: list = None  # labeled multi-chain traces (concurrency rules)
 
   def __post_init__(self):
     if not self.end_line:
       self.end_line = self.line
+    if self.chains and self.chain is None:
+      self.chain = self.chains[0]['hops']
 
   def location(self):
     return f'{self.path}:{self.line}:{self.col}'
 
   def as_dict(self):
-    """JSON-stable rendering (the ``--json`` schema v2, one entry per
-    finding): rule, path, line, col, message, hint, suppressed, chain."""
+    """JSON-stable rendering (the ``--json`` schema v3, one entry per
+    finding): rule, path, line, col, message, hint, suppressed, chain,
+    chains."""
     return {
         'rule': self.rule_id,
         'path': self.path,
@@ -50,16 +60,25 @@ class Finding:
         'hint': self.hint,
         'suppressed': self.suppressed,
         'chain': self.chain,
+        'chains': self.chains,
     }
+
+  @staticmethod
+  def _render_hops(hops):
+    head = ' → '.join(hop['name'] for hop in hops[:-1])
+    last = hops[-1]
+    sep = ' → ' if head else ''
+    return (f"{head}{sep}{last['name']}"
+            f" at {last['path']}:{last['line']}")
 
   def render(self):
     tag = ' (suppressed)' if self.suppressed else ''
     out = f'{self.location()}: {self.rule_id}{tag}: {self.message}'
-    if self.chain:
-      hops = ' → '.join(hop['name'] for hop in self.chain[:-1])
-      last = self.chain[-1]
-      out += (f"\n    via: {hops} → {last['name']}"
-              f" at {last['path']}:{last['line']}")
+    if self.chains:
+      for entry in self.chains:
+        out += f"\n    {entry['label']}: {self._render_hops(entry['hops'])}"
+    elif self.chain:
+      out += f'\n    via: {self._render_hops(self.chain)}'
     if self.hint:
       out += f'\n    hint: {self.hint}'
     return out

@@ -78,21 +78,30 @@ class ProjectIndex:
     self.attr_index = {}     # method name -> sorted tuple of class gqs
 
   @classmethod
-  def build(cls, files):
+  def build(cls, files, cache=None):
     """Parse + index every file (sorted); unparsable files are skipped
-    here — the per-file pass reports them as LDA000."""
+    here — the per-file pass reports them as LDA000. With a ``cache``,
+    unchanged files load their pickled ModuleFacts by content hash and
+    skip the parse (the dominant cost of a warm project run)."""
     index = cls()
     for path in sorted(files):
       try:
         with open(path, encoding='utf-8') as fh:
           source = fh.read()
-        tree = ast.parse(source, filename=path)
-      except (OSError, SyntaxError, ValueError):
+      except OSError:
         continue
       module = module_name_for(path)
       if module in index.modules:
         continue  # duplicate module name across roots: first (sorted) wins
-      facts = extract_module_facts(tree, path)
+      facts = cache.load('facts', path, source) if cache else None
+      if facts is None:
+        try:
+          tree = ast.parse(source, filename=path)
+        except (SyntaxError, ValueError):
+          continue
+        facts = extract_module_facts(tree, path)
+        if cache is not None:
+          cache.store('facts', path, source, facts)
       index.modules[module] = facts
       index.module_is_pkg[module] = (
           os.path.basename(path) == '__init__.py')
@@ -309,11 +318,12 @@ class ProjectRule:
     """Yield findings over the built project."""
     return ()
 
-  def finding(self, path, line, col, message, chain=None, hint=None):
+  def finding(self, path, line, col, message, chain=None, chains=None,
+              hint=None):
     return Finding(
         rule_id=self.rule_id, path=path, line=line, col=col,
         message=message, hint=self.hint if hint is None else hint,
-        chain=chain)
+        chain=chain, chains=chains)
 
 
 def build_chain(index, hops, target_gq, effect):
@@ -333,7 +343,8 @@ def build_chain(index, hops, target_gq, effect):
   return chain
 
 
-def analyze_project(paths, rules=None, jobs=None):
+def analyze_project(paths, rules=None, jobs=None, file_filter=None,
+                    cache=None):
   """Whole-program analysis: the per-file rules over every ``.py`` under
   ``paths`` (parallel when ``jobs`` allows) plus the interprocedural
   project rules over the linked index.
@@ -341,6 +352,14 @@ def analyze_project(paths, rules=None, jobs=None):
   Returns ``(findings, files_scanned)`` like :func:`analyze_paths`;
   project findings honor the same ``# lddl: noqa[...]`` pragmas, applied
   at the effect/call site they are anchored to.
+
+  ``file_filter`` (a set of absolute paths, from ``--changed``)
+  restricts the *per-file* pass to those files while the index and the
+  project rules still cover the whole tree — interprocedural claims
+  need every module, and the caller filters project findings down to
+  the ones whose chains touch the filter. ``files_scanned`` stays the
+  full tree count for the same reason. ``cache`` accelerates both
+  passes (cached findings + cached per-module facts).
   """
   if rules is None:
     file_rules = None
@@ -349,10 +368,16 @@ def analyze_project(paths, rules=None, jobs=None):
   else:
     file_rules = [r for r in rules if isinstance(r, Rule)]
     proj_rules = [r for r in rules if isinstance(r, ProjectRule)]
-  findings, files_scanned = analyze_paths(paths, rules=file_rules,
-                                          jobs=jobs)
   files = discover_py_files(paths)
-  index = ProjectIndex.build(files)
+  if file_filter is None:
+    findings, files_scanned = analyze_paths(paths, rules=file_rules,
+                                            jobs=jobs, cache=cache)
+  else:
+    targets = [p for p in files if os.path.abspath(p) in file_filter]
+    findings, _ = analyze_paths(targets, rules=file_rules, jobs=jobs,
+                                cache=cache)
+    files_scanned = len(files)
+  index = ProjectIndex.build(files, cache=cache)
   graph = CallGraph(index)
   project_findings = []
   for rule in proj_rules:

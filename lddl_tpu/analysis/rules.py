@@ -847,13 +847,16 @@ def default_rules():
 
 
 def project_rules():
-  """Fresh instances of every interprocedural (project-mode) rule."""
+  """Fresh instances of every interprocedural (project-mode) rule:
+  the call-graph rules here plus the thread-graph concurrency rules
+  (LDA014–LDA018) from :mod:`.concurrency`."""
+  from .concurrency import concurrency_rules
   return [
       TransitiveRankCollective(),
       ElasticPathPurity(),
       JitHostSync(),
       CollectiveOrderDivergence(),
-  ]
+  ] + concurrency_rules()
 
 
 def all_rules():

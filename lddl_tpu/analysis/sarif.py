@@ -29,8 +29,8 @@ def _location(path, line, col=None, message=None):
   return loc
 
 
-def _code_flow(chain):
-  return {
+def _code_flow(chain, label=None):
+  flow = {
       'threadFlows': [{
           'locations': [
               {'location': _location(hop['path'], hop['line'],
@@ -39,6 +39,9 @@ def _code_flow(chain):
           ],
       }],
   }
+  if label:
+    flow['message'] = {'text': label}
+  return flow
 
 
 def to_sarif(findings, rules):
@@ -59,7 +62,12 @@ def to_sarif(findings, rules):
       result['ruleIndex'] = rule_index[f.rule_id]
     if f.suppressed:
       result['suppressions'] = [{'kind': 'inSource'}]
-    if f.chain:
+    if f.chains:
+      # One codeFlow per labeled chain: a cross-thread finding shows the
+      # writer's thread path and the reader's main path side by side.
+      result['codeFlows'] = [_code_flow(c['hops'], label=c.get('label'))
+                             for c in f.chains]
+    elif f.chain:
       result['codeFlows'] = [_code_flow(f.chain)]
     results.append(result)
   return {

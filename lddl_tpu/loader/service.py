@@ -342,11 +342,18 @@ class DataServer:
     self._sock = srv
     self._port = srv.getsockname()[1]
     self.url = f'{self._host}:{self._port}'
-    for name, target in (('lddl-serve-produce', self._produce),
-                         ('lddl-serve-accept', self._accept)):
-      t = threading.Thread(target=target, name=name, daemon=True)
-      t.start()
-      self._threads.append(t)
+    # Spawn targets are named explicitly (not through a loop variable)
+    # so the lddl-analyze thread graph sees both spawn edges; the
+    # listener travels as an argument so the accept loop never reads
+    # self._sock, which stop() tears down from the main thread.
+    produce = threading.Thread(target=self._produce,
+                               name='lddl-serve-produce', daemon=True)
+    accept = threading.Thread(target=self._accept, args=(srv,),
+                              name='lddl-serve-accept', daemon=True)
+    produce.start()
+    accept.start()
+    with self._lock:  # _accept appends per-conn threads concurrently
+      self._threads.extend((produce, accept))
     self._announce()
     return self
 
@@ -355,9 +362,10 @@ class DataServer:
     self._stop.set()
     with self._lock:
       self._lock.notify_all()
-    for t in self._threads:
+      pending = list(self._threads)
+      self._threads = []
+    for t in pending:  # join outside the lock: workers still need it
       t.join(timeout=10.0)
-    self._threads = []
     if self._sock is not None:
       try:
         self._sock.close()
@@ -481,10 +489,10 @@ class DataServer:
 
   # -- connections
 
-  def _accept(self):
+  def _accept(self, srv):
     while not self._stop.is_set():
       try:
-        conn, addr = self._sock.accept()
+        conn, addr = srv.accept()
       except socket.timeout:
         continue
       except OSError:
@@ -493,7 +501,8 @@ class DataServer:
       t = threading.Thread(target=self._serve_conn, args=(conn,),
                            name='lddl-serve-conn', daemon=True)
       t.start()
-      self._threads.append(t)
+      with self._lock:  # stop() drains this list from the main thread
+        self._threads.append(t)
 
   def _serve_conn(self, conn):
     conn.settimeout(0.5)  # recv poll so the loop can observe stop()

@@ -90,6 +90,9 @@ class AsyncShardWriter:
                thread_name='lddl-write-back'):
     self._q = _queue.Queue(max_pending or _write_back_depth())
     self._err = None
+    # _err is written by the writer thread and read by flush()/failed on
+    # the submitting thread; the lock makes first-failure-wins atomic.
+    self._err_lock = threading.Lock()
     self._counter = counter
     self.backlog_hwm = 0  # max queue depth observed since last reset
     self._thread = threading.Thread(
@@ -111,8 +114,9 @@ class AsyncShardWriter:
         fn(*args, **kwargs)
         writes.add(1)
       except BaseException:
-        if self._err is None:  # first failure wins; later shards still run
-          self._err = traceback.format_exc()
+        with self._err_lock:
+          if self._err is None:  # first failure wins; later shards still run
+            self._err = traceback.format_exc()
       finally:
         self._q.task_done()
 
@@ -121,7 +125,8 @@ class AsyncShardWriter:
     """Whether any submitted job has failed (first error is retained).
     The manifest job checks this before publishing: a completion
     manifest must never vouch for a shard write that did not land."""
-    return self._err is not None
+    with self._err_lock:
+      return self._err is not None
 
   @property
   def backlog(self):
@@ -129,9 +134,10 @@ class AsyncShardWriter:
     return self._q.qsize()
 
   def _raise_pending(self):
-    if self._err is not None:
-      raise WriteBackError(
-          'background shard write failed:\n' + self._err)
+    with self._err_lock:
+      if self._err is not None:
+        raise WriteBackError(
+            'background shard write failed:\n' + self._err)
 
   def raise_pending(self):
     """Surface the first background failure, if any (first-error-wins).

@@ -540,6 +540,9 @@ def determinism_verdict(ledger=None):
 
 _ENV = 'LDDL_LEDGER'
 _active = None  # None: not yet resolved from the environment
+# First resolution can race: producer/writer threads and the main loop
+# all call get_ledger() lazily. The lock makes the install atomic.
+_active_lock = threading.Lock()
 
 
 def get_ledger():
@@ -547,25 +550,29 @@ def get_ledger():
   ``LDDL_LEDGER`` truthy or :func:`enable_ledger` called), else the
   shared :data:`NOOP_LEDGER` singleton."""
   global _active
-  if _active is None:
-    spec = os.environ.get(_ENV, '').strip().lower()
-    _active = Ledger() if spec in ('1', 'true', 'on', 'yes') else NOOP_LEDGER
-  return _active
+  with _active_lock:
+    if _active is None:
+      spec = os.environ.get(_ENV, '').strip().lower()
+      _active = (Ledger() if spec in ('1', 'true', 'on', 'yes')
+                 else NOOP_LEDGER)
+    return _active
 
 
 def enable_ledger(**kwargs):
   """Switch the ledger on (fresh instance unless already enabled)."""
   global _active
-  if _active is None or not _active.enabled:
-    _active = Ledger(**kwargs)
-  return _active
+  with _active_lock:
+    if _active is None or not _active.enabled:
+      _active = Ledger(**kwargs)
+    return _active
 
 
 def disable_ledger():
   """Switch the ledger off (instrument sites see :data:`NOOP_LEDGER`);
   closes the active file first."""
   global _active
-  if _active is not None and _active.enabled:
-    _active.close()
-  _active = NOOP_LEDGER
-  return _active
+  with _active_lock:
+    if _active is not None and _active.enabled:
+      _active.close()
+    _active = NOOP_LEDGER
+    return _active

@@ -30,6 +30,7 @@ import json
 import math
 import os
 import tempfile
+import threading
 import time
 
 
@@ -372,6 +373,9 @@ def diff_snapshot_lines(old, new):
 
 _ENV = 'LDDL_TELEMETRY'
 _active = None  # None: not yet resolved from the environment
+# First resolution can race: writer threads fetch counters lazily while
+# the main loop resolves the registry. The lock makes install atomic.
+_active_lock = threading.Lock()
 
 
 def get_telemetry():
@@ -379,22 +383,25 @@ def get_telemetry():
   ``LDDL_TELEMETRY`` truthy or :func:`enable` called), else the shared
   :data:`NOOP` singleton."""
   global _active
-  if _active is None:
-    spec = os.environ.get(_ENV, '').strip().lower()
-    _active = Telemetry() if spec in ('1', 'true', 'on', 'yes') else NOOP
-  return _active
+  with _active_lock:
+    if _active is None:
+      spec = os.environ.get(_ENV, '').strip().lower()
+      _active = Telemetry() if spec in ('1', 'true', 'on', 'yes') else NOOP
+    return _active
 
 
 def enable():
   """Switch telemetry on (fresh registry unless already enabled)."""
   global _active
-  if _active is None or not _active.enabled:
-    _active = Telemetry()
-  return _active
+  with _active_lock:
+    if _active is None or not _active.enabled:
+      _active = Telemetry()
+    return _active
 
 
 def disable():
   """Switch telemetry off (instrument sites see :data:`NOOP` again)."""
   global _active
-  _active = NOOP
-  return _active
+  with _active_lock:
+    _active = NOOP
+    return _active

@@ -223,7 +223,10 @@ def attach_args(parser):
                       help='series shorter than this are not judged '
                            '(default %(default)s)')
   parser.add_argument('--gate', action='store_true',
-                      help='exit 1 when any series regressed (CI mode)')
+                      help='exit 1 when any series regressed (CI mode); '
+                           'also runs the thread-graph concurrency lint '
+                           '(LDA014–LDA018) over the package and fails '
+                           'on any unsuppressed finding')
   parser.add_argument('--audit', nargs='+', metavar='LEDGER',
                       help='also run the determinism auditor over these '
                            'ledger paths: one path self-checks the run '
@@ -301,6 +304,42 @@ def check_incidents(root):
   return 0, 0
 
 
+_CONC_VERDICT = None
+
+
+def check_concurrency():
+  """``--gate``: run the thread-graph concurrency rules (LDA014–LDA018)
+  over the installed package. Returns ``(rc, count)`` — rc 1 when any
+  *unsuppressed* race/lifecycle/lock-order/signal/blocking finding
+  exists, each rendered with its labeled chains. A perf number captured
+  on a tree with an open deadlock or torn-read finding is not a number
+  CI should bless.
+
+  The verdict is memoized per process (the installed tree does not
+  change under us), so repeated --gate invocations — the test suite,
+  a CI script gating several artifact dirs — lint once; with
+  ``LDDL_ANALYZE_CACHE`` set even that first lint reuses parsed facts.
+  """
+  global _CONC_VERDICT
+  if _CONC_VERDICT is not None:
+    return _CONC_VERDICT
+  try:
+    from lddl_tpu.analysis import (CONCURRENCY_RULE_IDS, analyze_package,
+                                   cache_from_env)
+    unsuppressed, _ = analyze_package(cache=cache_from_env())
+  except Exception as e:  # analyzer itself must never crash the gate
+    print(f'lddl-perf: concurrency lint unavailable: {e}', file=sys.stderr)
+    return 0, 0
+  conc = [f for f in unsuppressed if f.rule_id in CONCURRENCY_RULE_IDS]
+  for f in conc:
+    print(f'lddl-perf: concurrency finding:\n{f.render()}', file=sys.stderr)
+  if conc:
+    print(f'lddl-perf: {len(conc)} unsuppressed concurrency finding(s)',
+          file=sys.stderr)
+  _CONC_VERDICT = (1 if conc else 0, len(conc))
+  return _CONC_VERDICT
+
+
 def run_replay_smoke(ledger_path, factory_spec=None, kwargs_json='{}'):
   """``--replay-smoke``: one random recorded coordinate per boundary,
   rematerialized and verified against its ledger line (skips
@@ -347,6 +386,10 @@ def main(argv=None):
   incident_rc, incident_count = 0, 0
   if args.incidents:
     incident_rc, incident_count = check_incidents(args.incidents)
+  # Concurrency leg only under --gate: it re-lints the whole package
+  # (cheap when LDDL_ANALYZE_CACHE is warm), which a report-only
+  # invocation shouldn't pay for.
+  conc_rc, conc_count = check_concurrency() if args.gate else (0, 0)
   series = gather_series(args.root, args.history)
   if not series:
     if args.incidents:
@@ -355,7 +398,7 @@ def main(argv=None):
       # sentinel must fail the gate either way.
       print(f'lddl-perf: no bench history under {args.root!r}; '
             'judging incidents only', file=sys.stderr)
-      return (incident_rc or audit_rc) if args.gate else 0
+      return (incident_rc or audit_rc or conc_rc) if args.gate else 0
     print(f'lddl-perf: no bench history under {args.root!r} '
           '(expected BENCH_r*.json / MULTICHIP_r*.json / '
           'bench_history.jsonl)', file=sys.stderr)
@@ -371,6 +414,8 @@ def main(argv=None):
       out['audit_exit'] = audit_rc
     if args.incidents:
       out['incidents'] = incident_count
+    if args.gate:
+      out['concurrency_findings'] = conc_count
     print(json.dumps(out, indent=2))
   else:
     for v in verdicts:
@@ -388,6 +433,8 @@ def main(argv=None):
       print('lddl-perf: determinism audit ok')
     if args.incidents and incident_rc == 0:
       print(f'lddl-perf: no incidents under {args.incidents}')
+    if args.gate and conc_rc == 0:
+      print('lddl-perf: concurrency lint clean')
   # One command, one verdict: under --gate a determinism failure or a
   # captured incident is a gate failure exactly like a perf regression
   # (perf's code wins when several fired, so CI triage starts from the
@@ -397,6 +444,8 @@ def main(argv=None):
     rc = incident_rc
   if args.gate and audit_rc and not rc:
     rc = audit_rc
+  if args.gate and conc_rc and not rc:
+    rc = conc_rc
   return rc
 
 

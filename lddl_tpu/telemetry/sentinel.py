@@ -358,6 +358,9 @@ class Sentinel:
 # -- module gate (ledger.py discipline: resolve once, Noop when unset)
 
 _active = None
+# Producer/heartbeat threads and the main loop race to the first
+# get_sentinel(); the lock makes the lazy install atomic.
+_active_lock = threading.Lock()
 
 
 def _parse_spec(spec):
@@ -374,23 +377,26 @@ def get_sentinel():
   """The process sentinel: a live :class:`Sentinel` when
   ``LDDL_SENTINEL`` is set, else the shared :data:`NOOP_SENTINEL`."""
   global _active
-  if _active is None:
-    names = _parse_spec(os.environ.get(_ENV, ''))
-    _active = Sentinel(detectors=names) if names else NOOP_SENTINEL
-  return _active
+  with _active_lock:
+    if _active is None:
+      names = _parse_spec(os.environ.get(_ENV, ''))
+      _active = Sentinel(detectors=names) if names else NOOP_SENTINEL
+    return _active
 
 
 def enable_sentinel(**kwargs):
   """Force-enable (tests): installs and returns a fresh sentinel."""
   global _active
-  _active = Sentinel(**kwargs)
-  return _active
+  with _active_lock:
+    _active = Sentinel(**kwargs)
+    return _active
 
 
 def disable_sentinel():
   """Force-disable and drop the active instance (tests)."""
   global _active
-  _active = NOOP_SENTINEL
+  with _active_lock:
+    _active = NOOP_SENTINEL
 
 
 def sentinel_status():

@@ -145,8 +145,11 @@ class FlightRecorder:
         try:
           self._record_batch(batch, loader, ordinal)
         except Exception as exc:
-          if not self._warned:
-            self._warned = True
+          # _warned is shared with capture(), which can run on another
+          # thread (sentinel triggers): claim the warning under the lock.
+          with self._lock:
+            first, self._warned = not self._warned, True
+          if first:
             print(f'flight: batch recording disabled after error: '
                   f'{type(exc).__name__}: {exc}', file=sys.stderr)
         ordinal += 1
@@ -192,9 +195,12 @@ class FlightRecorder:
     None. Never raises: an incident dump failing must not crash the
     training run it is documenting (the failure is reported instead)."""
     try:
-      if len(self.incident_dirs) >= self.max_incidents:
-        if not self._warned:
-          self._warned = True
+      with self._lock:  # triggers can fire from producer threads
+        capped = len(self.incident_dirs) >= self.max_incidents
+        first = capped and not self._warned
+        self._warned = self._warned or capped
+      if capped:
+        if first:
           print(f'flight: incident cap ({self.max_incidents}) reached; '
                 'further triggers are counted but not captured',
                 file=sys.stderr)
@@ -276,7 +282,8 @@ class FlightRecorder:
     with open(os.path.join(out, MANIFEST), 'w') as f:
       json.dump(manifest, f, indent=2, default=str)
       f.write('\n')
-    self.incident_dirs.append(out)
+    with self._lock:  # read concurrently by capture()'s cap check
+      self.incident_dirs.append(out)
     from ..telemetry.sentinel import get_sentinel
     get_sentinel().note_incident(out, trigger)
     return out
@@ -309,28 +316,34 @@ class FlightRecorder:
 # -- module gate: the recorder rides the sentinel's LDDL_SENTINEL gate
 
 _active = None
+# Sentinel triggers fire from producer threads while the train loop
+# resolves lazily on the main thread; the lock makes install atomic.
+_active_lock = threading.Lock()
 
 
 def get_flight_recorder():
   """The process flight recorder — live iff the sentinel is live."""
   global _active
-  if _active is None:
-    from ..telemetry.sentinel import get_sentinel
-    _active = FlightRecorder() if get_sentinel().enabled else NOOP_FLIGHT
-  return _active
+  with _active_lock:
+    if _active is None:
+      from ..telemetry.sentinel import get_sentinel
+      _active = FlightRecorder() if get_sentinel().enabled else NOOP_FLIGHT
+    return _active
 
 
 def enable_flight(**kwargs):
   """Force-enable (tests): installs and returns a fresh recorder."""
   global _active
-  _active = FlightRecorder(**kwargs)
-  return _active
+  with _active_lock:
+    _active = FlightRecorder(**kwargs)
+    return _active
 
 
 def disable_flight():
   """Force-disable and drop the active instance (tests)."""
   global _active
-  _active = NOOP_FLIGHT
+  with _active_lock:
+    _active = NOOP_FLIGHT
 
 
 # -- incident inventory (shared by lddl-incident and lddl-perf)

@@ -723,7 +723,7 @@ def test_cli_json_schema(tmp_path, capsys):
   rc = cli_main(['--json', dirty])
   out = json.loads(capsys.readouterr().out)
   assert rc == 1
-  assert out['version'] == 2
+  assert out['version'] == 3
   assert out['mode'] == 'files'
   assert out['files_scanned'] == 1
   assert out['num_findings'] == 1
@@ -733,10 +733,11 @@ def test_cli_json_schema(tmp_path, capsys):
   for f in out['findings']:
     assert set(f) == {
         'rule', 'path', 'line', 'col', 'message', 'hint', 'suppressed',
-        'chain',
+        'chain', 'chains',
     }
     assert f['rule'] == 'LDA001'
     assert f['chain'] is None  # per-file findings carry no call chain
+    assert f['chains'] is None
   flagged = [f for f in out['findings'] if not f['suppressed']]
   assert flagged[0]['line'] == 3
 

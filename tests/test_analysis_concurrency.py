@@ -1,0 +1,661 @@
+"""Concurrency-rule (LDA014–LDA018) tests over synthetic package trees:
+thread-graph spawn edges (including cross-module ones the call graph
+alone cannot see), lockset inference, dual call-chain rendering through
+text/JSON/SARIF, plus the incremental cache's cold/warm byte-identity
+and the parallel driver's determinism.
+
+Fixtures follow test_analysis_project.py: real on-disk packages, since
+project mode resolves imports by walking ``__init__.py`` chains.
+"""
+
+import json
+import textwrap
+
+from lddl_tpu.analysis import analyze_project
+from lddl_tpu.analysis.cli import main as cli_main
+from lddl_tpu.analysis.sarif import to_sarif
+
+
+def make_pkg(tmp_path, files):
+  root = tmp_path / 'proj'
+  root.mkdir()
+  for rel, src in sorted(files.items()):
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+  dirs = {root} | {p.parent for p in root.rglob('*.py')}
+  for d in dirs:
+    init = d / '__init__.py'
+    if not init.exists():
+      init.write_text('')
+  return root
+
+
+def findings_for(root, rule_id=None):
+  findings, _ = analyze_project([str(root)])
+  if rule_id is None:
+    return findings
+  return [f for f in findings if f.rule_id == rule_id]
+
+
+def unsuppressed_ids(root):
+  findings, _ = analyze_project([str(root)])
+  return sorted({f.rule_id for f in findings if not f.suppressed})
+
+
+# ---------------------------------------------------------------------------
+# LDA014: cross-thread shared state with no common lock
+
+
+_RACY_COUNTER = {
+    'worker.py': """
+        import threading
+
+
+        class Worker:
+          def __init__(self):
+            self.count = 0
+            self._t = threading.Thread(target=self._run, daemon=True)
+            self._t.start()
+
+          def _run(self):
+            while True:
+              self.count = self.count + 1
+
+          def status(self):
+            return self.count
+        """,
+}
+
+
+def test_lda014_flags_unlocked_cross_thread_attr(tmp_path):
+  root = make_pkg(tmp_path, _RACY_COUNTER)
+  hits = [f for f in findings_for(root, 'LDA014') if not f.suppressed]
+  assert len(hits) == 1
+  f = hits[0]
+  assert 'self.count' in f.message
+  assert 'no common lock' in f.message
+  # both chains, labeled by side, write first
+  assert [c['label'] for c in f.chains] == \
+      ['written via thread chain', 'read via main chain']
+  thread_hops = ' '.join(h['name'] for h in f.chains[0]['hops'])
+  assert 'spawns' in thread_hops and '_run' in thread_hops
+  main_hops = ' '.join(h['name'] for h in f.chains[1]['hops'])
+  assert 'status' in main_hops
+
+
+def test_lda014_clean_when_both_sides_hold_the_lock(tmp_path):
+  root = make_pkg(tmp_path, {
+      'worker.py': """
+          import threading
+
+
+          class Worker:
+            def __init__(self):
+              self.count = 0
+              self._lock = threading.Lock()
+              self._t = threading.Thread(target=self._run, daemon=True)
+              self._t.start()
+
+            def _run(self):
+              while True:
+                with self._lock:
+                  self.count = self.count + 1
+
+            def status(self):
+              with self._lock:
+                return self.count
+          """,
+  })
+  assert 'LDA014' not in unsuppressed_ids(root)
+
+
+def test_lda014_clean_for_queue_and_event_handoff(tmp_path):
+  """Internally synchronized containers are the sanctioned channel."""
+  root = make_pkg(tmp_path, {
+      'worker.py': """
+          import queue
+          import threading
+
+
+          class Worker:
+            def __init__(self):
+              self.out = queue.Queue()
+              self.done = threading.Event()
+              self._t = threading.Thread(target=self._run, daemon=True)
+              self._t.start()
+
+            def _run(self):
+              self.out.put(1)
+              self.done.set()
+
+            def status(self):
+              return self.done.is_set() and self.out.qsize()
+          """,
+  })
+  assert 'LDA014' not in unsuppressed_ids(root)
+
+
+def test_lda014_pragma_suppresses_with_reason(tmp_path):
+  src = _RACY_COUNTER['worker.py'].replace(
+      'self.count = self.count + 1',
+      'self.count = self.count + 1  '
+      '# lddl: noqa[LDA014] monotone hint counter; torn reads benign')
+  root = make_pkg(tmp_path, {'worker.py': src})
+  hits = findings_for(root, 'LDA014')
+  assert hits and all(f.suppressed for f in hits)
+
+
+def test_lda014_two_module_spawn_edge(tmp_path):
+  """The spawn lives in one module, the raced state in another — only
+  the thread graph's spawn edge connects them (the call graph has no
+  edge across Thread(target=...))."""
+  root = make_pkg(tmp_path, {
+      'workermod.py': """
+          total = 0
+
+
+          def worker_loop():
+            global total
+            while True:
+              total = total + 1
+
+
+          def snapshot():
+            return total
+          """,
+      'mainmod.py': """
+          import threading
+
+          from .workermod import worker_loop
+
+
+          def launch():
+            t = threading.Thread(target=worker_loop, daemon=True)
+            t.start()
+            return t
+          """,
+  })
+  hits = [f for f in findings_for(root, 'LDA014') if not f.suppressed]
+  assert len(hits) == 1
+  f = hits[0]
+  assert "global 'total'" in f.message
+  spawn_hop = f.chains[0]['hops'][0]
+  assert 'launch' in spawn_hop['name'] and 'spawns' in spawn_hop['name']
+  assert spawn_hop['path'].endswith('mainmod.py')
+  assert f.path.endswith('workermod.py')
+
+
+# ---------------------------------------------------------------------------
+# LDA015: thread lifecycle (spawn discipline + shutdown joins)
+
+
+def test_lda015_spawn_without_daemon_or_join(tmp_path):
+  root = make_pkg(tmp_path, {
+      'spawn.py': """
+          import threading
+
+
+          def fire_and_forget(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+          """,
+  })
+  hits = [f for f in findings_for(root, 'LDA015') if not f.suppressed]
+  assert len(hits) == 1
+  assert 'neither daemon=True nor a reachable join' in hits[0].message
+
+
+def test_lda015_daemon_spawn_is_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'spawn.py': """
+          import threading
+
+
+          def fire_and_forget(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+          """,
+  })
+  assert 'LDA015' not in unsuppressed_ids(root)
+
+
+def test_lda015_unbounded_join_on_shutdown_path(tmp_path):
+  """The PR 9 deadlock class: close() joins the worker forever."""
+  root = make_pkg(tmp_path, {
+      'pool.py': """
+          import threading
+
+
+          class Pool:
+            def __init__(self):
+              self._worker = threading.Thread(target=self._run)
+              self._worker.start()
+
+            def _run(self):
+              while True:
+                pass
+
+            def close(self):
+              self._worker.join()
+          """,
+  })
+  hits = [f for f in findings_for(root, 'LDA015') if not f.suppressed]
+  assert len(hits) == 1
+  f = hits[0]
+  assert 'without a timeout' in f.message
+  assert 'close' in f.message
+  assert f.chains[0]['label'] == 'shutdown path'
+
+
+def test_lda015_bounded_shutdown_join_is_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'pool.py': """
+          import threading
+
+
+          class Pool:
+            def __init__(self):
+              self._worker = threading.Thread(target=self._run)
+              self._worker.start()
+
+            def _run(self):
+              while True:
+                pass
+
+            def close(self):
+              self._worker.join(timeout=5.0)
+          """,
+  })
+  assert 'LDA015' not in unsuppressed_ids(root)
+
+
+def test_lda015_pragma_suppresses(tmp_path):
+  root = make_pkg(tmp_path, {
+      'pool.py': """
+          import threading
+
+
+          class Pool:
+            def __init__(self):
+              self._worker = threading.Thread(target=self._run)
+              self._worker.start()
+
+            def _run(self):
+              return None
+
+            def close(self):
+              # lddl: noqa[LDA015] worker provably exits after one item
+              self._worker.join()
+          """,
+  })
+  hits = findings_for(root, 'LDA015')
+  assert hits and all(f.suppressed for f in hits)
+
+
+# ---------------------------------------------------------------------------
+# LDA016: lock-order inversion
+
+
+def test_lda016_cross_method_inversion(tmp_path):
+  root = make_pkg(tmp_path, {
+      'locks.py': """
+          import threading
+
+
+          class Shared:
+            def __init__(self):
+              self.lock_a = threading.Lock()
+              self.lock_b = threading.Lock()
+
+            def forward(self):
+              with self.lock_a:
+                with self.lock_b:
+                  return 1
+
+            def backward(self):
+              with self.lock_b:
+                with self.lock_a:
+                  return 2
+          """,
+  })
+  hits = [f for f in findings_for(root, 'LDA016') if not f.suppressed]
+  assert len(hits) == 1
+  f = hits[0]
+  assert 'lock order inversion' in f.message
+  assert 'forward' in f.message and 'backward' in f.message
+  labels = [c['label'] for c in f.chains]
+  assert labels == sorted(labels) and len(labels) == 2
+
+
+def test_lda016_interprocedural_inversion(tmp_path):
+  """One side of the inversion sits behind a call: forward() holds A
+  and calls a helper that takes B; backward() nests B then A."""
+  root = make_pkg(tmp_path, {
+      'locks.py': """
+          import threading
+
+
+          class Shared:
+            def __init__(self):
+              self.lock_a = threading.Lock()
+              self.lock_b = threading.Lock()
+
+            def _under_b(self):
+              with self.lock_b:
+                return 1
+
+            def forward(self):
+              with self.lock_a:
+                return self._under_b()
+
+            def backward(self):
+              with self.lock_b:
+                with self.lock_a:
+                  return 2
+          """,
+  })
+  assert [f for f in findings_for(root, 'LDA016') if not f.suppressed]
+
+
+def test_lda016_consistent_order_is_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'locks.py': """
+          import threading
+
+
+          class Shared:
+            def __init__(self):
+              self.lock_a = threading.Lock()
+              self.lock_b = threading.Lock()
+
+            def forward(self):
+              with self.lock_a:
+                with self.lock_b:
+                  return 1
+
+            def also_forward(self):
+              with self.lock_a:
+                with self.lock_b:
+                  return 2
+          """,
+  })
+  assert 'LDA016' not in unsuppressed_ids(root)
+
+
+# ---------------------------------------------------------------------------
+# LDA017: signal-handler safety (the PreemptionGuard bug class)
+
+
+_GUARD = """
+    import signal
+    import threading
+
+
+    class Guard:
+      def __init__(self):
+        self._flag = threading.Event()
+        self._lock = threading.Lock()
+        self.hits = 0
+
+      def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+      def _on_signal(self, signum, frame):
+        {body}
+"""
+
+
+def test_lda017_lock_acquisition_in_handler(tmp_path):
+  src = _GUARD.format(body="""with self._lock:
+          self.hits = self.hits + 1""")
+  root = make_pkg(tmp_path, {'guard.py': src})
+  hits = [f for f in findings_for(root, 'LDA017') if not f.suppressed]
+  assert hits
+  f = hits[0]
+  assert 'signal handler' in f.message
+  hop_names = ' '.join(h['name'] for h in f.chains[0]['hops'])
+  assert 'signal.signal' in hop_names
+
+
+def test_lda017_flag_set_only_handler_is_clean(tmp_path):
+  """The fixed PreemptionGuard shape: the handler only sets an Event."""
+  src = _GUARD.format(body='self._flag.set()')
+  root = make_pkg(tmp_path, {'guard.py': src})
+  assert 'LDA017' not in unsuppressed_ids(root)
+
+
+def test_lda017_reaches_through_helper_calls(tmp_path):
+  src = _GUARD.format(body='self._note()') + """
+      def _note(self):
+        with self._lock:
+          self.hits = self.hits + 1
+"""
+  root = make_pkg(tmp_path, {'guard.py': textwrap.dedent(src)})
+  hits = [f for f in findings_for(root, 'LDA017') if not f.suppressed]
+  assert hits
+  hop_names = ' '.join(h['name'] for h in hits[0].chains[0]['hops'])
+  assert '_note' in hop_names
+
+
+# ---------------------------------------------------------------------------
+# LDA018: blocking call while holding a lock
+
+
+def test_lda018_blocking_get_under_lock(tmp_path):
+  root = make_pkg(tmp_path, {
+      'drain.py': """
+          import queue
+          import threading
+
+
+          class Drain:
+            def __init__(self):
+              self._lock = threading.Lock()
+              self._q = queue.Queue()
+
+            def take(self):
+              with self._lock:
+                return self._q.get()
+          """,
+  })
+  hits = [f for f in findings_for(root, 'LDA018') if not f.suppressed]
+  assert len(hits) == 1
+  assert '_q.get()' in hits[0].message
+  assert '_lock' in hits[0].message
+
+
+def test_lda018_timeout_get_and_cv_wait_are_clean(tmp_path):
+  root = make_pkg(tmp_path, {
+      'drain.py': """
+          import queue
+          import threading
+
+
+          class Drain:
+            def __init__(self):
+              self._cv = threading.Condition()
+              self._q = queue.Queue()
+              self.ready = False
+
+            def take(self):
+              with self._cv:
+                return self._q.get(timeout=1.0)
+
+            def wait_ready(self):
+              with self._cv:
+                while not self.ready:
+                  self._cv.wait()
+          """,
+  })
+  assert 'LDA018' not in unsuppressed_ids(root)
+
+
+def test_lda018_sleep_under_lock(tmp_path):
+  root = make_pkg(tmp_path, {
+      'nap.py': """
+          import threading
+          import time
+
+          _lock = threading.Lock()
+
+
+          def pause():
+            with _lock:
+              time.sleep(1.0)
+          """,
+  })
+  hits = [f for f in findings_for(root, 'LDA018') if not f.suppressed]
+  assert len(hits) == 1
+  assert 'time.sleep' in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# the seeded acceptance fixture: PR 9 deadlock + unlocked cross-thread
+# write, both call chains named in text, JSON, and SARIF
+
+
+_SEEDED = {
+    'server.py': """
+        import threading
+
+
+        class Server:
+          def __init__(self):
+            self.requests = 0
+            self._t = threading.Thread(target=self._serve)
+            self._t.start()
+
+          def _serve(self):
+            while True:
+              self.requests = self.requests + 1
+
+          def stats(self):
+            return self.requests
+
+          def shutdown(self):
+            self._t.join()
+        """,
+}
+
+
+def test_seeded_fixture_text_names_both_chains(tmp_path, capsys,
+                                               monkeypatch):
+  root = make_pkg(tmp_path, _SEEDED)
+  monkeypatch.delenv('LDDL_ANALYZE_CACHE', raising=False)
+  assert cli_main([str(root)]) == 1
+  out = capsys.readouterr().out
+  assert 'LDA014' in out and 'LDA015' in out
+  assert 'written via thread chain:' in out
+  assert 'read via main chain:' in out
+  assert 'spawns' in out
+  assert 'shutdown path:' in out
+
+
+def test_seeded_fixture_json_carries_chains(tmp_path, capsys,
+                                            monkeypatch):
+  root = make_pkg(tmp_path, _SEEDED)
+  monkeypatch.delenv('LDDL_ANALYZE_CACHE', raising=False)
+  assert cli_main(['--format', 'json', str(root)]) == 1
+  doc = json.loads(capsys.readouterr().out)
+  assert doc['version'] == 3
+  race = [f for f in doc['findings'] if f['rule'] == 'LDA014']
+  assert len(race) == 1
+  labels = [c['label'] for c in race[0]['chains']]
+  assert labels == ['written via thread chain', 'read via main chain']
+  # back-compat: chain mirrors the first chains entry
+  assert race[0]['chain'] == race[0]['chains'][0]['hops']
+  join = [f for f in doc['findings'] if f['rule'] == 'LDA015']
+  assert join and join[0]['chains'][0]['label'] == 'shutdown path'
+
+
+def test_seeded_fixture_sarif_code_flows(tmp_path):
+  root = make_pkg(tmp_path, _SEEDED)
+  findings, _ = analyze_project([str(root)])
+  from lddl_tpu.analysis.rules import all_rules
+  doc = to_sarif(findings, all_rules())
+  results = doc['runs'][0]['results']
+  race = [r for r in results if r['ruleId'] == 'LDA014']
+  assert len(race) == 1
+  flows = race[0]['codeFlows']
+  assert [f['message']['text'] for f in flows] == \
+      ['written via thread chain', 'read via main chain']
+  for flow in flows:
+    locs = flow['threadFlows'][0]['locations']
+    assert len(locs) >= 2
+    assert all(loc['location']['message']['text'] for loc in locs)
+
+
+# ---------------------------------------------------------------------------
+# incremental cache: cold/warm byte-identity, --no-cache, parse skip
+
+
+def _cli_json(argv, capsys):
+  code = cli_main(argv)
+  return code, capsys.readouterr().out
+
+
+def test_cache_warm_run_is_byte_identical(tmp_path, capsys, monkeypatch):
+  root = make_pkg(tmp_path, _SEEDED)
+  monkeypatch.setenv('LDDL_ANALYZE_CACHE', str(tmp_path / 'cache'))
+  code1, cold = _cli_json(['--format', 'json', str(root)], capsys)
+  code2, warm = _cli_json(['--format', 'json', str(root)], capsys)
+  assert (code1, cold) == (code2, warm)
+  code3, nocache = _cli_json(
+      ['--format', 'json', '--no-cache', str(root)], capsys)
+  assert (code3, nocache) == (code1, cold)
+  assert list((tmp_path / 'cache').iterdir())
+
+
+def test_cache_warm_run_skips_parsing_entirely(tmp_path, capsys,
+                                               monkeypatch):
+  """The mechanism behind the >=5x warm speedup on the real tree: after
+  a cold run, both the per-file findings and the project facts come
+  from the cache, so neither analyze_file nor extract_module_facts
+  runs again."""
+  import lddl_tpu.analysis.engine as engine_mod
+  import lddl_tpu.analysis.project as project_mod
+  root = make_pkg(tmp_path, _SEEDED)
+  monkeypatch.setenv('LDDL_ANALYZE_CACHE', str(tmp_path / 'cache'))
+  monkeypatch.setenv('LDDL_ANALYZE_JOBS', '1')  # keep analysis in-proc
+  code1, cold = _cli_json(['--format', 'json', str(root)], capsys)
+
+  def _boom(*a, **k):
+    raise AssertionError('warm run should not re-analyze')
+
+  monkeypatch.setattr(engine_mod, 'analyze_file', _boom)
+  monkeypatch.setattr(project_mod, 'extract_module_facts', _boom)
+  code2, warm = _cli_json(['--format', 'json', str(root)], capsys)
+  assert (code1, cold) == (code2, warm)
+
+
+def test_cache_invalidates_on_edit(tmp_path, capsys, monkeypatch):
+  root = make_pkg(tmp_path, _SEEDED)
+  monkeypatch.setenv('LDDL_ANALYZE_CACHE', str(tmp_path / 'cache'))
+  _, before = _cli_json(['--format', 'json', str(root)], capsys)
+  src = (root / 'server.py').read_text().replace(
+      'self._t.join()', 'self._t.join(timeout=5.0)')
+  (root / 'server.py').write_text(src)
+  code, after = _cli_json(['--format', 'json', str(root)], capsys)
+  doc = json.loads(after)
+  assert not [f for f in doc['findings'] if f['rule'] == 'LDA015']
+  assert [f for f in doc['findings'] if f['rule'] == 'LDA014']
+
+
+# ---------------------------------------------------------------------------
+# determinism: --jobs must not change a single output byte
+
+
+def test_jobs_parallel_output_is_byte_identical(tmp_path, capsys,
+                                                monkeypatch):
+  files = {}
+  for i in range(10):  # enough files to clear the parallel threshold
+    files[f'mod{i}.py'] = _RACY_COUNTER['worker.py'].replace(
+        'class Worker', f'class Worker{i}')
+  root = make_pkg(tmp_path, files)
+  monkeypatch.delenv('LDDL_ANALYZE_CACHE', raising=False)
+  code1, serial = _cli_json(
+      ['--format', 'json', '--jobs', '1', str(root)], capsys)
+  code2, parallel = _cli_json(
+      ['--format', 'json', '--jobs', '4', str(root)], capsys)
+  assert (code1, serial) == (code2, parallel)

@@ -390,7 +390,7 @@ def test_cli_json_chain_snapshot(tmp_path, capsys, monkeypatch):
   monkeypatch.chdir(tmp_path)
   assert cli_main(['--format', 'json', str(root)]) == 1
   doc = json.loads(capsys.readouterr().out)
-  assert doc['version'] == 2
+  assert doc['version'] == 3
   assert doc['mode'] == 'project'
   chained = [f for f in doc['findings'] if f['rule'] == 'LDA008']
   assert len(chained) == 1

@@ -7,7 +7,8 @@ unbounded wait, or jit host-sync either gets fixed or gets an explicit
 ``# lddl: noqa[LDAxxx]`` pragma with a reason, never merged silently.
 
 ``analyze_package`` runs in project mode: the whole-program call graph
-is built and LDA008–LDA011 run alongside the per-file rules.
+is built and LDA008–LDA011 plus the thread-graph concurrency rules
+LDA014–LDA018 run alongside the per-file rules.
 """
 
 import json
@@ -31,6 +32,20 @@ def test_package_tree_has_zero_unsuppressed_findings():
   assert len(suppressed) == 11, \
       'suppressed-finding count changed: ' + \
       '\n'.join(f.render() for f in suppressed)
+
+
+def test_concurrency_rules_clean_with_no_suppressions():
+  """LDA014–LDA018 over the real tree: every race/lifecycle/lock-order/
+  signal/blocking finding the thread graph surfaced was *fixed* (the
+  singleton installs, the pool's _err slot, the data-server thread
+  list), not pragma'd — so the concurrency ruleset runs with zero
+  suppressions. A new pragma here must come with a reason and a bump of
+  this count."""
+  from lddl_tpu.analysis import CONCURRENCY_RULE_IDS
+  unsuppressed, suppressed = analyze_package()
+  conc = [f for f in unsuppressed + suppressed
+          if f.rule_id in CONCURRENCY_RULE_IDS]
+  assert not conc, '\n'.join(f.render() for f in conc)
 
 
 def test_elastic_path_is_pure():
